@@ -1,0 +1,78 @@
+"""Converter golden-parity tests: torch MobileNetV2 -> Flax.
+
+The torch model here is a test oracle reproducing torchvision's module
+nesting / state_dict keys (tests/torch_ref_mobilenetv2.py). Parity of
+converted weights is checked end-to-end on logits, including BatchNorm
+running statistics updated by real train-mode passes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from tpunet.config import ModelConfig
+from tpunet.models.convert import convert_torch_state_dict, merge_pretrained
+from tpunet.models.mobilenetv2 import create_model, init_variables
+
+from torch_ref_mobilenetv2 import TorchMobileNetV2
+
+
+@pytest.fixture(scope="module")
+def torch_model():
+    torch.manual_seed(0)
+    m = TorchMobileNetV2(num_classes=10)
+    # Update BN running stats away from the (0, 1) init so the stats
+    # conversion is actually exercised.
+    m.train()
+    with torch.no_grad():
+        for _ in range(3):
+            m(torch.randn(8, 3, 64, 64))
+    m.eval()
+    return m
+
+
+def _flax_from_torch(torch_model, num_classes=10):
+    model = create_model(ModelConfig(dtype="float32"))
+    variables = init_variables(model, jax.random.PRNGKey(0), image_size=64)
+    p, s, head_ok = convert_torch_state_dict(
+        torch_model.state_dict(), num_classes=num_classes)
+    return model, merge_pretrained(variables, p, s, head_ok), head_ok
+
+
+def test_logit_parity(torch_model):
+    model, variables, head_ok = _flax_from_torch(torch_model)
+    assert head_ok
+    x = np.random.default_rng(1).normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = torch_model(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(model.apply(variables, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=1e-3)
+
+
+def test_head_swap_on_class_mismatch(torch_model):
+    # ImageNet checkpoints have a 1000-way head; the converter must keep
+    # the fresh 10-way head (reference head swap, :138-139).
+    sd = dict(torch_model.state_dict())
+    sd["classifier.1.weight"] = torch.randn(1000, 1280)
+    sd["classifier.1.bias"] = torch.randn(1000)
+    model = create_model(ModelConfig(dtype="float32"))
+    variables = init_variables(model, jax.random.PRNGKey(0), image_size=64)
+    p, s, head_ok = convert_torch_state_dict(sd, num_classes=10)
+    assert not head_ok
+    merged = merge_pretrained(variables, p, s, head_ok)
+    np.testing.assert_array_equal(
+        np.asarray(merged["params"]["classifier"]["kernel"]),
+        np.asarray(variables["params"]["classifier"]["kernel"]))
+    # Backbone still converted and usable.
+    x = jnp.zeros((1, 64, 64, 3))
+    assert model.apply(merged, x, train=False).shape == (1, 10)
+
+
+def test_ddp_module_prefix_stripped(torch_model):
+    sd = {f"module.{k}": v for k, v in torch_model.state_dict().items()}
+    p, _s, head_ok = convert_torch_state_dict(sd, num_classes=10)
+    assert head_ok
+    assert p["stem"]["conv"]["kernel"].shape == (3, 3, 3, 32)

@@ -1,0 +1,58 @@
+"""Model construction / shape / parameter-count tests."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpunet.config import ModelConfig
+from tpunet.models.mobilenetv2 import create_model, init_variables, num_params
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = create_model(ModelConfig(dtype="float32"))
+    variables = init_variables(model, jax.random.PRNGKey(0), image_size=64)
+    return model, variables
+
+
+def test_param_count_matches_reference(model_and_vars):
+    # Reference logs "Total parameters: 2236682" (cifar_mpi_gpu128_26188.out:30)
+    _, variables = model_and_vars
+    assert num_params(variables["params"]) == 2_236_682
+
+
+def test_forward_shapes_and_dtype(model_and_vars):
+    model, variables = model_and_vars
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_train_mode_updates_batch_stats(model_and_vars):
+    model, variables = model_and_vars
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64, 64, 3))
+    logits, mutated = model.apply(
+        variables, x, train=True,
+        rngs={"dropout": jax.random.PRNGKey(2)},
+        mutable=["batch_stats"])
+    assert logits.shape == (4, 10)
+    old = variables["batch_stats"]["stem"]["bn"]["mean"]
+    new = mutated["batch_stats"]["stem"]["bn"]["mean"]
+    assert not jnp.allclose(old, new)
+
+
+def test_jit_matches_eager(model_and_vars):
+    model, variables = model_and_vars
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 64, 64, 3))
+    eager = model.apply(variables, x, train=False)
+    jitted = jax.jit(lambda v, x: model.apply(v, x, train=False))(variables, x)
+    assert jnp.allclose(eager, jitted, atol=1e-5)
+
+
+def test_width_multiplier_changes_params():
+    small = create_model(ModelConfig(width_mult=0.5, dtype="float32"))
+    variables = init_variables(small, jax.random.PRNGKey(0), image_size=32)
+    assert num_params(variables["params"]) < 2_236_682
+    x = jnp.zeros((1, 32, 32, 3))
+    assert small.apply(variables, x, train=False).shape == (1, 10)

@@ -1,0 +1,66 @@
+"""Torch-side MobileNetV2 used ONLY as a test oracle for the weight
+converter (torchvision is not installed in this environment).
+
+Built from the MobileNetV2 paper recipe with module nesting chosen to
+reproduce torchvision's state_dict key naming (``features.0.0.weight``,
+``features.N.conv...``, ``classifier.1.weight``), so the converter is
+exercised against the exact key layout it must handle in production.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch import nn
+
+SETTINGS = [
+    (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+    (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+]
+
+
+def conv_bn_relu(cin, cout, k, stride=1, groups=1):
+    return nn.Sequential(
+        nn.Conv2d(cin, cout, k, stride, (k - 1) // 2, groups=groups, bias=False),
+        nn.BatchNorm2d(cout),
+        nn.ReLU6(inplace=True),
+    )
+
+
+class TorchInvertedResidual(nn.Module):
+    def __init__(self, cin, cout, stride, expand):
+        super().__init__()
+        hidden = cin * expand
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers.append(conv_bn_relu(cin, hidden, 1))
+        layers.extend([
+            conv_bn_relu(hidden, hidden, 3, stride, groups=hidden),
+            nn.Conv2d(hidden, cout, 1, bias=False),
+            nn.BatchNorm2d(cout),
+        ])
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.conv(x)
+        return x + y if self.use_res else y
+
+
+class TorchMobileNetV2(nn.Module):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        features = [conv_bn_relu(3, 32, 3, 2)]
+        cin = 32
+        for t, c, n, s in SETTINGS:
+            for i in range(n):
+                features.append(
+                    TorchInvertedResidual(cin, c, s if i == 0 else 1, t))
+                cin = c
+        features.append(conv_bn_relu(cin, 1280, 1))
+        self.features = nn.Sequential(*features)
+        self.classifier = nn.Sequential(nn.Dropout(0.2), nn.Linear(1280, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.mean(dim=(2, 3))
+        return self.classifier(x)

@@ -1,0 +1,27 @@
+"""tpunet — a TPU-native distributed training framework.
+
+Rebuilds the capabilities of the reference project "Distributed AI Model
+Training using MPI and GPU Acceleration" (C-DAC PG-HPC diploma project:
+MobileNetV2 transfer learning on CIFAR-10 at 224x224 run serial / single
+accelerator / distributed data-parallel, plus top-k inference behind a web
+app and cluster launchers) as an idiomatic JAX/XLA framework:
+
+- ``tpunet.config``   — dataclass config with the reference hyperparameter
+  defaults (224px, batch 64/128, Adam 1e-4, StepLR(10, 0.1), 20 epochs,
+  seed 42; cf. reference cifar10_mpi_mobilenet_224.py:58,70,117,147-149,158).
+- ``tpunet.models``   — Flax MobileNetV2 + torch-state_dict weight converter.
+- ``tpunet.data``     — CIFAR-10 loading, per-host sharding iterator, and
+  fully on-device fused augmentation (replaces torchvision transforms +
+  DataLoader workers; cf. reference :68-133).
+- ``tpunet.train``    — jitted train/eval steps, metrics, epoch loop with
+  best-checkpoint tracking (cf. reference :163-240).
+- ``tpunet.parallel`` — device mesh / sharding / multi-host bootstrap
+  (replaces mpi4py + torch.distributed NCCL; cf. reference :22-48).
+- ``tpunet.ckpt``     — Orbax best-params + full-state save/resume
+  (upgrade over reference's torch.save-at-end, :238-249).
+- ``tpunet.infer``    — jitted top-k inference + (optional) Gradio app
+  (cf. reference cifar10_serial_mobilenet_224.py:159-188, GROUP03.pdf
+  pp.22-23).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,221 @@
+"""Configuration system.
+
+The reference hardcodes every hyperparameter as a module literal
+(cifar10_mpi_mobilenet_224.py: IMG_SIZE=224 at :70, batch=128 at :117,
+Adam lr=1e-4 at :148, StepLR(10, 0.1) at :149, EPOCHS=20 at :158,
+seed=42 at :58). We keep those exact values as *defaults* of a frozen
+dataclass tree so every benchmark config is reproducible, and expose an
+argparse front-end with presets matching the reference's three launch
+modes (serial CPU / single accelerator / distributed data-parallel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+# ImageNet normalization statistics — the reference trains with these
+# (cifar10_mpi_mobilenet_224.py:81-82) and its Gradio app wrongly serves
+# with CIFAR-10 stats (GROUP03.pdf p.22, a train/serve skew bug we fix by
+# using one constant everywhere).
+IMAGENET_MEAN: Tuple[float, float, float] = (0.485, 0.456, 0.406)
+IMAGENET_STD: Tuple[float, float, float] = (0.229, 0.224, 0.225)
+
+CIFAR10_CLASSES: Tuple[str, ...] = (
+    "airplane", "automobile", "bird", "cat", "deer",
+    "dog", "frog", "horse", "ship", "truck",
+)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Data pipeline config (reference transforms at :72-89, loaders :117-133)."""
+
+    data_dir: str = "data"
+    dataset: str = "cifar10"          # "cifar10" | "synthetic"
+    image_size: int = 224             # reference IMG_SIZE (:70)
+    batch_size: int = 128             # GLOBAL batch (reference :117 is per-rank)
+    eval_batch_size: int = 0          # 0 -> same as batch_size
+    num_classes: int = 10
+    # Augmentation parameters mirroring the reference torchvision stack
+    # (:72-82): RandomResizedCrop scale, ColorJitter strengths, rotation.
+    rrc_scale: Tuple[float, float] = (0.7, 1.0)
+    rrc_ratio: Tuple[float, float] = (0.75, 4.0 / 3.0)
+    jitter_brightness: float = 0.3
+    jitter_contrast: float = 0.3
+    jitter_saturation: float = 0.3
+    jitter_hue: float = 0.1
+    rotation_degrees: float = 15.0
+    mean: Tuple[float, float, float] = IMAGENET_MEAN
+    std: Tuple[float, float, float] = IMAGENET_STD
+    # Deviation from torch DistributedSampler (which pads shards to equal
+    # length, :119-124): we drop the train remainder and evaluate the test
+    # set exactly (padding with masked examples), which also fixes the
+    # reference's rank-local-accuracy wart (:196,224).
+    drop_remainder: bool = True
+
+    @property
+    def effective_eval_batch_size(self) -> int:
+        return self.eval_batch_size or self.batch_size
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Model config (reference model at :137-139: torchvision MobileNetV2
+    with the classifier head swapped to 10 classes)."""
+
+    name: str = "mobilenet_v2"
+    num_classes: int = 10
+    width_mult: float = 1.0
+    dropout_rate: float = 0.2         # torchvision MobileNetV2 default
+    dtype: str = "bfloat16"           # MXU-friendly compute dtype
+    param_dtype: str = "float32"
+    # Optional path to a torch state_dict (.pth) with ImageNet-pretrained
+    # weights to convert (transfer learning is load-bearing for the ~96%
+    # accuracy target — reference README.md:24-26).
+    pretrained_path: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Optimizer config (reference :147-149: Adam 1e-4 + StepLR(10, 0.1))."""
+
+    name: str = "adam"
+    learning_rate: float = 1e-4
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    # StepLR equivalents: decay lr by `gamma` every `step_size_epochs`.
+    step_size_epochs: int = 10
+    gamma: float = 0.1
+    label_smoothing: float = 0.0
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh config. The reference's only strategy is data parallelism
+    (DDP at :142-145); we build a 2-D ('data', 'model') mesh so the design
+    leaves a model axis open for tensor-parallel sharding (SURVEY.md 2b).
+    """
+
+    data: int = -1                    # -1 -> all remaining devices
+    model: int = 1
+
+    def shape(self, n_devices: int) -> Tuple[int, int]:
+        model = max(1, self.model)
+        data = self.data if self.data > 0 else max(1, n_devices // model)
+        return (data, model)
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    directory: str = "checkpoints"
+    save_best: bool = True            # reference best-by-test-acc (:238-240)
+    save_last: bool = True            # upgrade: full state for resume
+    resume: bool = False
+    keep: int = 2
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Top-level config."""
+
+    epochs: int = 20                  # reference EPOCHS (:158)
+    seed: int = 42                    # reference torch.manual_seed(42) (:58)
+    log_every_steps: int = 0          # 0 -> per-epoch only, like the reference
+    profile_dir: str = ""             # non-empty -> jax.profiler traces
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Presets: the reference's three launch modes (SURVEY.md section 0).
+# ---------------------------------------------------------------------------
+
+def preset(name: str) -> TrainConfig:
+    """Return the config for a named launch mode.
+
+    - ``serial``      — reference cifar10_serial_mobilenet_224.py: batch 64.
+    - ``single``      — reference cifar10_128batch.py: batch 128, one chip.
+    - ``distributed`` — reference cifar10_mpi_mobilenet_224.py: 128 per
+      device (global batch = 128 * n_devices is resolved at runtime).
+    """
+    base = TrainConfig()
+    if name == "serial":
+        return base.replace(data=dataclasses.replace(base.data, batch_size=64))
+    if name == "single":
+        return base
+    if name == "distributed":
+        return base  # global batch scaled by the caller from mesh size
+    raise ValueError(f"unknown preset {name!r}; expected serial|single|distributed")
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="tpunet trainer")
+    p.add_argument("--preset", default="single",
+                   choices=["serial", "single", "distributed"])
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="global batch size")
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--dataset", default=None, choices=["cifar10", "synthetic"])
+    p.add_argument("--pretrained", default=None,
+                   help="path to a torch MobileNetV2 state_dict to convert")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--mesh-data", type=int, default=None)
+    p.add_argument("--mesh-model", type=int, default=None)
+    p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
+    p.add_argument("--profile-dir", default=None)
+    return p
+
+
+def config_from_args(argv=None) -> TrainConfig:
+    args = build_argparser().parse_args(argv)
+    cfg = preset(args.preset)
+    data, model, optim, mesh, ckpt = cfg.data, cfg.model, cfg.optim, cfg.mesh, cfg.checkpoint
+    if args.batch_size is not None:
+        data = dataclasses.replace(data, batch_size=args.batch_size)
+    if args.image_size is not None:
+        data = dataclasses.replace(data, image_size=args.image_size)
+    if args.data_dir is not None:
+        data = dataclasses.replace(data, data_dir=args.data_dir)
+    if args.dataset is not None:
+        data = dataclasses.replace(data, dataset=args.dataset)
+    if args.pretrained is not None:
+        model = dataclasses.replace(model, pretrained_path=args.pretrained)
+    if args.dtype is not None:
+        model = dataclasses.replace(model, dtype=args.dtype)
+    if args.lr is not None:
+        optim = dataclasses.replace(optim, learning_rate=args.lr)
+    if args.mesh_data is not None:
+        mesh = dataclasses.replace(mesh, data=args.mesh_data)
+    if args.mesh_model is not None:
+        mesh = dataclasses.replace(mesh, model=args.mesh_model)
+    if args.checkpoint_dir is not None:
+        ckpt = dataclasses.replace(ckpt, directory=args.checkpoint_dir)
+    if args.resume:
+        ckpt = dataclasses.replace(ckpt, resume=True)
+    cfg = cfg.replace(data=data, model=model, optim=optim, mesh=mesh, checkpoint=ckpt)
+    if args.epochs is not None:
+        cfg = cfg.replace(epochs=args.epochs)
+    if args.seed is not None:
+        cfg = cfg.replace(seed=args.seed)
+    if args.profile_dir is not None:
+        cfg = cfg.replace(profile_dir=args.profile_dir)
+    return cfg

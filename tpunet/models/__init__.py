@@ -1,0 +1,2 @@
+from tpunet.models.mobilenetv2 import MobileNetV2, create_model  # noqa: F401
+from tpunet.models.convert import convert_torch_state_dict, load_pretrained  # noqa: F401

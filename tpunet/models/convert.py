@@ -1,0 +1,149 @@
+"""torchvision MobileNetV2 state_dict -> Flax variables converter.
+
+Transfer learning from ImageNet-pretrained weights is load-bearing for the
+reference's ~96% CIFAR-10 accuracy (reference README.md:24-26; model built
+at cifar10_mpi_mobilenet_224.py:137-139). This module converts a torch
+``state_dict`` (torchvision key layout) into this package's Flax
+``{'params', 'batch_stats'}`` tree:
+
+- conv weights: torch (O, I, kH, kW) -> flax (kH, kW, I, O)
+- depthwise conv: torch (C, 1, kH, kW), groups=C -> flax (kH, kW, 1, C)
+  (same transpose; flax ``feature_group_count`` handles grouping)
+- linear: torch (out, in) -> flax (in, out)
+- BatchNorm: weight->scale, bias->bias, running_mean/var -> batch_stats
+
+torchvision key scheme handled (verified against torchvision 0.x
+mobilenet_v2): ``features.0.{0,1}`` stem, ``features.{1..17}.conv.*``
+inverted residuals (expand absent in block 1 where t=1),
+``features.18.{0,1}`` head conv, ``classifier.1`` linear. ``module.``
+prefixes (from DDP-wrapped saves, reference :249) are stripped. If the
+checkpoint head has a different class count (e.g. 1000 ImageNet classes),
+the head is left at its fresh random init — exactly the reference's
+head-swap (:138-139).
+
+No torch import is required unless loading a ``.pth`` via
+:func:`load_pretrained`; :func:`convert_torch_state_dict` accepts any
+mapping of numpy-convertible arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpunet.models.mobilenetv2 import INVERTED_RESIDUAL_SETTINGS
+
+
+def _np(x) -> np.ndarray:
+    """Coerce a torch tensor / array-like to a float32 numpy array."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def _conv(w) -> np.ndarray:
+    return _np(w).transpose(2, 3, 1, 0)  # OIHW -> HWIO
+
+
+def _block_specs() -> Tuple[Tuple[str, int, bool], ...]:
+    """(flax block name, torch features index, has expand) per block."""
+    specs = []
+    idx = 0
+    for t, _c, n, _s in INVERTED_RESIDUAL_SETTINGS:
+        for _ in range(n):
+            specs.append((f"block{idx:02d}", idx + 1, t != 1))
+            idx += 1
+    return tuple(specs)
+
+
+def convert_torch_state_dict(
+    state_dict: Mapping[str, object],
+    num_classes: int = 10,
+) -> Tuple[Dict, Dict, bool]:
+    """Convert a torch state_dict to (params, batch_stats, head_converted).
+
+    ``head_converted`` is False when the checkpoint's classifier has a
+    different output dimension than ``num_classes`` (the caller keeps its
+    randomly-initialized head — the transfer-learning head swap).
+    """
+    sd = {k.removeprefix("module."): v for k, v in state_dict.items()}
+
+    params: Dict = {}
+    stats: Dict = {}
+
+    def convbn(flax_path: Tuple[str, ...], conv_key: str, bn_key: str):
+        node = params
+        for p in flax_path:
+            node = node.setdefault(p, {})
+        node["conv"] = {"kernel": jnp.asarray(_conv(sd[f"{conv_key}.weight"]))}
+        node["bn"] = {
+            "scale": jnp.asarray(_np(sd[f"{bn_key}.weight"])),
+            "bias": jnp.asarray(_np(sd[f"{bn_key}.bias"])),
+        }
+        snode = stats
+        for p in flax_path:
+            snode = snode.setdefault(p, {})
+        snode["bn"] = {
+            "mean": jnp.asarray(_np(sd[f"{bn_key}.running_mean"])),
+            "var": jnp.asarray(_np(sd[f"{bn_key}.running_var"])),
+        }
+
+    convbn(("stem",), "features.0.0", "features.0.1")
+    for name, fi, has_expand in _block_specs():
+        base = f"features.{fi}.conv"
+        if has_expand:
+            convbn((name, "expand"), f"{base}.0.0", f"{base}.0.1")
+            convbn((name, "depthwise"), f"{base}.1.0", f"{base}.1.1")
+            convbn((name, "project"), f"{base}.2", f"{base}.3")
+        else:
+            convbn((name, "depthwise"), f"{base}.0.0", f"{base}.0.1")
+            convbn((name, "project"), f"{base}.1", f"{base}.2")
+    convbn(("head",), "features.18.0", "features.18.1")
+
+    head_converted = False
+    w = _np(sd["classifier.1.weight"])
+    if w.shape[0] == num_classes:
+        params["classifier"] = {
+            "kernel": jnp.asarray(w.T),
+            "bias": jnp.asarray(_np(sd["classifier.1.bias"])),
+        }
+        head_converted = True
+    return params, stats, head_converted
+
+
+def merge_pretrained(variables: Dict, params: Dict, stats: Dict,
+                     head_converted: bool) -> Dict:
+    """Overlay converted weights onto freshly-initialized variables."""
+    new_params = dict(variables["params"])
+    for k, v in params.items():
+        new_params[k] = v
+    if not head_converted:
+        new_params["classifier"] = variables["params"]["classifier"]
+    new_stats = dict(variables["batch_stats"])
+    for k, v in stats.items():
+        if k in ("classifier",):
+            continue
+        merged = dict(new_stats.get(k, {}))
+        merged.update(v)
+        new_stats[k] = merged
+    return {"params": new_params, "batch_stats": new_stats}
+
+
+def load_pretrained(path: str, variables: Dict, num_classes: int = 10) -> Dict:
+    """Load a torch ``.pth`` checkpoint and overlay it onto ``variables``.
+
+    Accepts either a bare state_dict or a dict containing one under a
+    conventional key ('state_dict' / 'model').
+    """
+    import torch  # local import: torch is optional at runtime
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and not any(hasattr(v, "shape") for v in obj.values()):
+        for key in ("state_dict", "model", "params"):
+            if key in obj:
+                obj = obj[key]
+                break
+    params, stats, head_ok = convert_torch_state_dict(obj, num_classes)
+    return merge_pretrained(variables, params, stats, head_ok)

@@ -1,0 +1,3 @@
+from tpunet.utils.logging import epoch_line, log0, is_coordinator  # noqa: F401
+from tpunet.utils.prng import epoch_key, step_key  # noqa: F401
+from tpunet.utils.timing import Timer  # noqa: F401

@@ -1,0 +1,49 @@
+"""Rank-0 logging with the reference's epoch-line format.
+
+The reference prints one line per epoch from rank 0 only
+(cifar10_mpi_mobilenet_224.py:229-236), captured by SLURM stdout
+redirection. Serial format (cifar10_128_gpu_27326.out:30):
+
+    Epoch 1/20 Time: 570.94s Train Loss: 0.5879 Train Acc: 0.8007 \
+Test Loss: 0.2834 Test Acc: 0.9027
+
+We replicate that format exactly so runs are directly comparable with the
+reference's published logs. Unlike the reference's distributed mode (which
+printed a rank-local "Test Acc(local)", :196,224), our accuracy is always
+globally reduced, so we always use the serial field names.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+
+def is_coordinator() -> bool:
+    """True on the process allowed to do I/O (reference rank==0 guards)."""
+    return jax.process_index() == 0
+
+
+def log0(*args, **kwargs) -> None:
+    """Print from the coordinator process only; flush for SLURM-style logs."""
+    if is_coordinator():
+        print(*args, **kwargs)
+        sys.stdout.flush()
+
+
+def epoch_line(epoch: int, epochs: int, seconds: float, train_loss: float,
+               train_acc: float, test_loss: float, test_acc: float) -> str:
+    return (
+        f"Epoch {epoch}/{epochs} Time: {seconds:.2f}s "
+        f"Train Loss: {train_loss:.4f} Train Acc: {train_acc:.4f} "
+        f"Test Loss: {test_loss:.4f} Test Acc: {test_acc:.4f}"
+    )
+
+
+def summary_lines(best_acc: float, total_seconds: float) -> list[str]:
+    """Reference end-of-run lines (cifar10_128_gpu_27326.out:51-52)."""
+    return [
+        f"Best test accuracy: {best_acc:.4f}",
+        f"Total training time: {total_seconds:.2f}s ({total_seconds / 60:.2f} min)",
+    ]

@@ -1,0 +1,27 @@
+"""Deterministic PRNG plumbing.
+
+The reference seeds every rank identically with torch.manual_seed(42)
+(cifar10_mpi_mobilenet_224.py:58) and relies on DistributedSampler's
+set_epoch for per-epoch reshuffles (:165). Here a single root key is
+folded with the epoch (shuffle key) and with the global step (augmentation
+key); per-example independence comes from vmap key splitting, so results
+are identical regardless of mesh shape or host count.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def epoch_key(seed: int, epoch: int) -> jax.Array:
+    """Key for the epoch-level shuffle (DistributedSampler.set_epoch analog)."""
+    return jax.random.fold_in(root_key(seed), epoch)
+
+
+def step_key(seed: int, step: int) -> jax.Array:
+    """Key for per-step data augmentation; step is the global step counter."""
+    return jax.random.fold_in(jax.random.fold_in(root_key(seed), 0x5EED), step)

@@ -1,0 +1,17 @@
+"""Wall-clock timing, matching the reference's time.time() epoch/total
+timers (cifar10_mpi_mobilenet_224.py:161,164,227,242)."""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __init__(self):
+        self.start = time.time()
+
+    def reset(self) -> None:
+        self.start = time.time()
+
+    def elapsed(self) -> float:
+        return time.time() - self.start
