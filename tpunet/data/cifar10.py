@@ -1,0 +1,93 @@
+"""CIFAR-10 loading.
+
+The reference downloads CIFAR-10 through torchvision with a rank-0 +
+barrier dance (cifar10_mpi_mobilenet_224.py:93-102). Here the dataset is
+read directly from the standard ``cifar-10-batches-py`` pickle layout
+(what torchvision's download produces), kept fully in host memory
+(50k x 32x32x3 uint8 = 150 MB), and sharded per host by the pipeline.
+A deterministic synthetic dataset stands in when the real data is absent
+(hermetic tests / benchmarks in no-egress environments).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Tuple
+
+import numpy as np
+
+from tpunet.config import DataConfig
+
+Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+_BATCH_DIR = "cifar-10-batches-py"
+_TARBALL = "cifar-10-python.tar.gz"
+
+
+def _read_batch(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NHWC
+    labels = np.asarray(d[b"labels"], dtype=np.int32)
+    return np.ascontiguousarray(data), labels
+
+
+def load_cifar10(data_dir: str) -> Arrays:
+    """Load CIFAR-10 from ``data_dir`` (extracting the tarball if needed).
+
+    Returns (train_x[50000,32,32,3] u8, train_y, test_x[10000,...], test_y).
+    """
+    data_dir = os.path.expanduser(data_dir)
+    batch_dir = os.path.join(data_dir, _BATCH_DIR)
+    tarball = os.path.join(data_dir, _TARBALL)
+    if not os.path.isdir(batch_dir) and os.path.exists(tarball):
+        with tarfile.open(tarball, "r:gz") as tf:
+            tf.extractall(data_dir)
+    if not os.path.isdir(batch_dir):
+        raise FileNotFoundError(
+            f"CIFAR-10 not found under {data_dir!r} (expected "
+            f"{_BATCH_DIR}/ or {_TARBALL}). Place the standard "
+            "cifar-10-python.tar.gz there, or run with "
+            "--dataset synthetic.")
+    xs, ys = [], []
+    for i in range(1, 6):
+        x, y = _read_batch(os.path.join(batch_dir, f"data_batch_{i}"))
+        xs.append(x)
+        ys.append(y)
+    train_x = np.concatenate(xs)
+    train_y = np.concatenate(ys)
+    test_x, test_y = _read_batch(os.path.join(batch_dir, "test_batch"))
+    return train_x, train_y, test_x, test_y
+
+
+def synthetic_cifar10(n_train: int = 50_000, n_test: int = 10_000,
+                      num_classes: int = 10, seed: int = 0) -> Arrays:
+    """Deterministic class-separable stand-in with CIFAR-10 shapes.
+
+    Each class is a fixed low-frequency color pattern plus noise, so a
+    model can actually fit it (used by convergence smoke tests).
+    """
+    rng = np.random.default_rng(seed)
+    protos = rng.uniform(40, 215, size=(num_classes, 8, 8, 3))
+
+    def make(n, salt):
+        r = np.random.default_rng(seed + salt)
+        y = r.integers(0, num_classes, size=n).astype(np.int32)
+        base = protos[y]                                   # (n, 8, 8, 3)
+        img = np.repeat(np.repeat(base, 4, axis=1), 4, axis=2)
+        img = img + r.normal(0, 24, size=img.shape)
+        return np.clip(img, 0, 255).astype(np.uint8), y
+
+    train_x, train_y = make(n_train, 1)
+    test_x, test_y = make(n_test, 2)
+    return train_x, train_y, test_x, test_y
+
+
+def get_dataset(cfg: DataConfig) -> Arrays:
+    if cfg.dataset == "synthetic":
+        return synthetic_cifar10()
+    if cfg.dataset == "cifar10":
+        return load_cifar10(cfg.data_dir)
+    raise ValueError(f"unknown dataset {cfg.dataset!r}")
