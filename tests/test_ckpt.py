@@ -1,0 +1,63 @@
+"""Checkpoint/resume tests (reference parity: best-by-test-acc saving,
+cifar10_mpi_mobilenet_224.py:238-249; upgrade: true resume, which the
+reference lacks — it always restarts from epoch 0)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from tpunet.config import CheckpointConfig
+from tpunet.train.loop import Trainer
+
+from test_train import tiny_config, tiny_dataset  # noqa: F401
+
+
+def _cfg(tmp_path, epochs):
+    cfg = tiny_config(tmp_path, epochs=epochs)
+    return cfg.replace(checkpoint=CheckpointConfig(
+        directory=str(tmp_path / "ckpt"), save_best=True, save_last=True))
+
+
+def test_best_and_state_saved(tmp_path, tiny_dataset):  # noqa: F811
+    cfg = _cfg(tmp_path, epochs=2)
+    t = Trainer(cfg, dataset=tiny_dataset)
+    t.train()
+    t.ckpt.close()
+    assert t.ckpt.latest_step() == 2
+    best = t.ckpt.restore_best({
+        "params": t.state.params, "batch_stats": t.state.batch_stats})
+    assert best is not None
+    chex_shape = jax.tree_util.tree_structure(best["params"])
+    assert chex_shape == jax.tree_util.tree_structure(t.state.params)
+
+
+def test_resume_continues_from_epoch(tmp_path, tiny_dataset):  # noqa: F811
+    cfg = _cfg(tmp_path, epochs=2)
+    t = Trainer(cfg, dataset=tiny_dataset)
+    hist = t.train()
+    t.ckpt.close()
+    assert len(hist) == 2
+
+    cfg3 = _cfg(tmp_path, epochs=3).replace(
+        checkpoint=dataclasses.replace(
+            _cfg(tmp_path, 3).checkpoint, resume=True))
+    t2 = Trainer(cfg3, dataset=tiny_dataset)
+    assert t2.start_epoch == 3          # continues, not restarts
+    assert t2.global_step == t.global_step
+    assert np.isclose(t2.best_acc, t.best_acc)
+    # Restored params equal the saved ones.
+    a = jax.tree_util.tree_leaves(t.state.params)[0]
+    b = jax.tree_util.tree_leaves(t2.state.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    hist2 = t2.train()
+    assert len(hist2) == 1              # only epoch 3 runs
+    t2.ckpt.close()
+
+
+def test_fresh_run_ignores_missing_checkpoint(tmp_path, tiny_dataset):  # noqa: F811
+    cfg = _cfg(tmp_path, epochs=1).replace(
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "none"),
+                                    resume=True))
+    t = Trainer(cfg, dataset=tiny_dataset)
+    assert t.start_epoch == 1

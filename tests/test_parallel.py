@@ -1,0 +1,49 @@
+"""Mesh / sharding unit tests on the 8-device virtual CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpunet.config import MeshConfig
+from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
+                             shard_host_batch)
+
+
+def test_default_mesh_uses_all_devices():
+    mesh = make_mesh(MeshConfig())
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.shape["data"] == 8 and mesh.shape["model"] == 1
+
+
+def test_explicit_mesh_shape():
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+
+def test_mesh_subset_of_devices():
+    mesh = make_mesh(MeshConfig(data=2, model=1))
+    assert mesh.devices.size == 2
+
+
+def test_mesh_too_large_raises():
+    with pytest.raises(ValueError, match="needs"):
+        make_mesh(MeshConfig(data=16, model=1))
+
+
+def test_shard_host_batch_roundtrip():
+    mesh = make_mesh(MeshConfig())
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    gx = shard_host_batch(mesh, x)
+    assert gx.shape == (8, 4)
+    assert gx.sharding.spec == P(("data",))
+    np.testing.assert_array_equal(jax.device_get(gx), x)
+    # each device holds exactly one row
+    assert all(s.data.shape == (1, 4) for s in gx.addressable_shards)
+
+
+def test_replicated_sharding_spec():
+    mesh = make_mesh(MeshConfig())
+    assert replicated_sharding(mesh).spec == P()
+    assert batch_sharding(mesh).spec == P(("data",))

@@ -1,0 +1,100 @@
+"""Training-core tests: optimization stack, step semantics, device-count
+invariance (the TPU analogue of the reference's serial-vs-distributed
+accuracy parity check, SURVEY.md section 4)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpunet.config import (DataConfig, MeshConfig, ModelConfig, OptimConfig,
+                           TrainConfig, CheckpointConfig)
+from tpunet.data.cifar10 import synthetic_cifar10
+from tpunet.parallel import make_mesh
+from tpunet.train.loop import Trainer
+from tpunet.train.state import lr_schedule
+from tpunet.utils.prng import step_key
+
+
+def tiny_config(tmpdir, batch=16, epochs=1, image_size=32):
+    # Stochastic augmentations off: these tests validate optimization and
+    # device-count invariance, not augmentation (covered in test_data).
+    return TrainConfig(
+        epochs=epochs,
+        seed=42,
+        data=DataConfig(dataset="synthetic", image_size=image_size,
+                        batch_size=batch, rrc_scale=(1.0, 1.0),
+                        rrc_ratio=(1.0, 1.0), jitter_brightness=0.0,
+                        jitter_contrast=0.0, jitter_saturation=0.0,
+                        jitter_hue=0.0, rotation_degrees=0.0),
+        model=ModelConfig(dtype="float32", width_mult=0.5),
+        optim=OptimConfig(learning_rate=1e-3),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(directory=str(tmpdir), save_best=False,
+                                    save_last=False),
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_dataset():
+    return synthetic_cifar10(n_train=128, n_test=48, seed=7)
+
+
+def test_steplr_schedule_matches_reference():
+    # StepLR(step_size=10, gamma=0.1): lr 1e-4 for epochs 1-10, 1e-5 for
+    # 11-20 (reference :149). 5 steps/epoch here.
+    sched = lr_schedule(OptimConfig(), steps_per_epoch=5, epochs=20)
+    assert np.isclose(sched(0), 1e-4)
+    assert np.isclose(sched(49), 1e-4)       # end of epoch 10
+    assert np.isclose(sched(50), 1e-5)       # start of epoch 11
+    assert np.isclose(sched(99), 1e-5)
+
+
+def test_train_loss_decreases(tmp_path, tiny_dataset):
+    cfg = tiny_config(tmp_path, epochs=3)
+    t = Trainer(cfg, dataset=tiny_dataset)
+    hist = t.train()
+    assert len(hist) == 3
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+    assert all(np.isfinite(h["train_loss"]) for h in hist)
+    # Separable synthetic data: should beat the 10% random baseline fast.
+    assert hist[-1]["train_accuracy"] > 0.2
+
+
+def test_eval_counts_exact(tmp_path, tiny_dataset):
+    cfg = tiny_config(tmp_path)
+    t = Trainer(cfg, dataset=tiny_dataset)
+    m = t.evaluate()
+    assert m["count"] == 48  # exact despite batch padding (48 = 3*16)
+
+
+def test_metrics_identical_across_mesh_sizes(tmp_path, tiny_dataset):
+    """Same global batch => same loss whether on 1 device or 8 (the
+    reference validated distributed correctness by accuracy parity)."""
+    cfg = tiny_config(tmp_path, batch=16, epochs=1)
+    t1 = Trainer(cfg.replace(mesh=MeshConfig(data=1)), dataset=tiny_dataset)
+    t8 = Trainer(cfg.replace(mesh=MeshConfig(data=8)), dataset=tiny_dataset)
+    # Identical initial states => eval parity is tight (differences are
+    # only float reduction order across device topologies).
+    e1 = t1.evaluate()
+    e8 = t8.evaluate()
+    assert e1["count"] == e8["count"] == 48
+    assert np.isclose(e1["loss"], e8["loss"], rtol=1e-4)
+    assert np.isclose(e1["accuracy"], e8["accuracy"], atol=1e-6)
+    # After a full epoch of updates, reduction-order noise is amplified
+    # through Adam (eps=1e-8); parity is statistical, like the
+    # reference's serial-vs-distributed accuracy comparison.
+    m1 = t1.train_one_epoch(0)
+    m8 = t8.train_one_epoch(0)
+    assert m1["count"] == m8["count"]
+    assert np.isclose(m1["loss"], m8["loss"], rtol=2e-2)
+    assert np.isclose(m1["accuracy"], m8["accuracy"], atol=0.08)
+
+
+def test_step_rng_differs_per_step():
+    assert not np.array_equal(
+        jax.random.key_data(step_key(42, 0)),
+        jax.random.key_data(step_key(42, 1)))

@@ -1,0 +1,1 @@
+from tpunet.ckpt.orbax_io import Checkpointer  # noqa: F401

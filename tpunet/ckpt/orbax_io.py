@@ -1,0 +1,89 @@
+"""Orbax checkpointing: best-params (parity) + full-state resume (upgrade).
+
+The reference deep-copies the state_dict whenever test accuracy improves
+and torch.saves the best copy once at the very end, from rank 0 only
+(cifar10_mpi_mobilenet_224.py:160,238-240,249); optimizer/scheduler/epoch
+state is never persisted, so a crashed run restarts from scratch
+(SURVEY.md section 5). Here:
+
+- ``save_best`` persists the best params+batch_stats *when* they improve
+  (crash-safe, unlike save-at-end), under ``best/``;
+- ``save_state`` persists the FULL train state (params, batch_stats,
+  optimizer state, step, epoch, best accuracy) per epoch under a
+  step-numbered directory, enabling exact resume;
+- restores are sharding-aware: arrays come back laid out for the current
+  mesh (orbax handles multi-host saves natively).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from tpunet.config import CheckpointConfig
+
+
+class Checkpointer:
+    def __init__(self, cfg: CheckpointConfig):
+        self.cfg = cfg
+        self.directory = os.path.abspath(os.path.expanduser(cfg.directory))
+        self._mgr: Optional[ocp.CheckpointManager] = None
+        self._best = ocp.StandardCheckpointer()
+
+    @property
+    def manager(self) -> ocp.CheckpointManager:
+        if self._mgr is None:
+            self._mgr = ocp.CheckpointManager(
+                os.path.join(self.directory, "state"),
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=self.cfg.keep, create=True),
+            )
+        return self._mgr
+
+    # -- full train state (resume) -------------------------------------
+
+    def save_state(self, step: int, payload: Dict[str, Any]) -> None:
+        if not self.cfg.save_last:
+            return
+        self.manager.save(step, args=ocp.args.StandardSave(payload))
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore_state(self, target: Dict[str, Any],
+                      step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Restore the latest (or given) step into ``target``'s structure
+        and shardings; returns None when no checkpoint exists."""
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            return None
+        return self.manager.restore(
+            step, args=ocp.args.StandardRestore(target))
+
+    # -- best params (reference parity) --------------------------------
+
+    def save_best(self, payload: Dict[str, Any]) -> None:
+        if not self.cfg.save_best:
+            return
+        path = os.path.join(self.directory, "best")
+        self._best.save(path, payload, force=True)
+
+    def restore_best(self, target: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        path = os.path.join(self.directory, "best")
+        if not os.path.isdir(path):
+            return None
+        return self._best.restore(path, target=target)
+
+    def wait(self) -> None:
+        """Block until async writes are durable (end of run)."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+        self._best.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        if self._mgr is not None:
+            self._mgr.close()

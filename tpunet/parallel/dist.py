@@ -1,0 +1,61 @@
+"""Multi-host bootstrap (replaces mpi4py + torch.distributed rendezvous).
+
+The reference boots with mpirun -> MPI.COMM_WORLD rank discovery
+(cifar10_mpi_mobilenet_224.py:24-26) -> env-var TCP rendezvous with a
+hardcoded localhost:29500 master (:28-35) -> NCCL process group. The JAX
+equivalent is a single :func:`jax.distributed.initialize` call: on TPU
+pods the coordinator and process topology come from the platform
+metadata, so no addresses are hardcoded; on CPU/GPU clusters they can be
+passed explicitly or via standard env vars (JAX_COORDINATOR_ADDRESS,
+JAX_NUM_PROCESSES, JAX_PROCESS_ID).
+
+`rank % device_count` device binding (:38-40) has no analogue — JAX owns
+local devices automatically. `dist.barrier()` gating the dataset download
+(:102) maps to :func:`sync_hosts`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[str] = None) -> None:
+    """Initialize multi-controller JAX when running as part of a pod/cluster.
+
+    Safe to call unconditionally: a no-op for single-process runs unless
+    explicit arguments or JAX_* rendezvous env vars are present.
+    """
+    env = os.environ
+    configured = (coordinator_address or num_processes
+                  or env.get("JAX_COORDINATOR_ADDRESS")
+                  or env.get("JAX_NUM_PROCESSES"))
+    on_tpu_pod = env.get("TPU_WORKER_HOSTNAMES") or env.get("MEGASCALE_COORDINATOR_ADDRESS")
+    if not (configured or on_tpu_pod):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_index() -> int:
+    """This process's rank (reference `rank`, :25)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """World size (reference `world_size`, :26)."""
+    return jax.process_count()
+
+
+def sync_hosts(name: str = "barrier") -> None:
+    """Cross-host barrier (reference dist.barrier(), :102)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)

@@ -1,0 +1,66 @@
+"""Device mesh and sharding helpers.
+
+This is the TPU-native replacement for the reference's parallelism stack
+(DistributedDataParallel wrap at cifar10_mpi_mobilenet_224.py:142-145 and
+the `rank % device_count` device binding at :38-40): instead of one
+process per device with bucketed NCCL allreduce hooks, we build a
+``jax.sharding.Mesh`` over all devices and jit the train step with the
+batch sharded on the ``data`` axis and parameters replicated — XLA then
+inserts the gradient all-reduce (over ICI on a TPU slice) itself, fused
+into the step program.
+
+The mesh is 2-D ``('data', 'model')`` so tensor-parallel param sharding
+can be layered on without restructuring (the reference is DP-only;
+SURVEY.md section 2b).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpunet.config import MeshConfig
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    data, model = cfg.shape(len(devices))
+    n = data * model
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {data}x{model} needs {n} devices, have {len(devices)}")
+    if n == len(devices):
+        dmesh = mesh_utils.create_device_mesh((data, model), devices=devices)
+    else:
+        dmesh = np.asarray(devices[:n]).reshape(data, model)
+    return Mesh(dmesh, ("data", "model"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding over the data axis (DistributedSampler analog)."""
+    return NamedSharding(mesh, P(("data",)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Full replication (the reference keeps params replicated, README:77)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_host_batch(mesh: Mesh, *arrays):
+    """Assemble global device arrays from this host's shard of the batch.
+
+    Works identically on one host (slices go to local devices) and on a
+    multi-host pod (each host contributes its slice of the global batch,
+    concatenated in process order).
+    """
+    sh = batch_sharding(mesh)
+    out = tuple(
+        jax.make_array_from_process_local_data(sh, np.asarray(a))
+        for a in arrays)
+    return out if len(out) > 1 else out[0]

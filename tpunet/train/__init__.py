@@ -1,0 +1,4 @@
+from tpunet.train.state import TrainState, create_train_state, make_optimizer  # noqa: F401
+from tpunet.train.steps import make_train_step, make_eval_step  # noqa: F401
+from tpunet.train.metrics import Metrics, zeros_metrics, accumulate, summarize  # noqa: F401
+from tpunet.train.loop import Trainer  # noqa: F401

@@ -1,0 +1,44 @@
+"""Globally-exact metrics as psum-able sums.
+
+The reference accumulates loss*batch and correct counts per rank, then
+all-reduces only the losses — accuracy stays a rank-local approximation
+(cifar10_mpi_mobilenet_224.py:181-196,216-224). Here every metric is a
+(loss_sum, correct, count) triple of *global* sums: reductions happen
+inside the jitted step over the globally-sharded batch, so XLA inserts
+the cross-device psum and all three numbers are exact on any mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+Metrics = Dict[str, jax.Array]
+
+
+def from_batch(loss_sum, correct, count) -> Metrics:
+    return {
+        "loss_sum": jnp.asarray(loss_sum, jnp.float32),
+        "correct": jnp.asarray(correct, jnp.float32),
+        "count": jnp.asarray(count, jnp.float32),
+    }
+
+
+def zeros_metrics() -> Metrics:
+    return from_batch(0.0, 0.0, 0.0)
+
+
+def accumulate(acc: Metrics, new: Metrics) -> Metrics:
+    return jax.tree_util.tree_map(jnp.add, acc, new)
+
+
+def summarize(acc: Metrics) -> Dict[str, float]:
+    """Device scalars -> python floats {loss, accuracy, count}."""
+    count = max(float(acc["count"]), 1.0)
+    return {
+        "loss": float(acc["loss_sum"]) / count,
+        "accuracy": float(acc["correct"]) / count,
+        "count": float(acc["count"]),
+    }

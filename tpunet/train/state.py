@@ -1,0 +1,70 @@
+"""Train state and optimization stack.
+
+Reference optimization stack (cifar10_mpi_mobilenet_224.py:147-149):
+CrossEntropyLoss + Adam(lr=1e-4) + StepLR(step_size=10, gamma=0.1), with
+BatchNorm statistics carried by the model. Here the whole thing is one
+pytree (params, batch_stats, optimizer state, step) updated by a pure
+function, and StepLR becomes an optax piecewise-constant schedule over
+*steps* (epoch boundaries x steps_per_epoch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from flax.training import train_state
+
+from tpunet.config import ModelConfig, OptimConfig
+from tpunet.models.convert import load_pretrained
+from tpunet.models.mobilenetv2 import create_model, init_variables
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState + BatchNorm running statistics."""
+
+    batch_stats: Any = None
+
+
+def lr_schedule(cfg: OptimConfig, steps_per_epoch: int, epochs: int):
+    """StepLR(step_size, gamma) as a step-indexed schedule."""
+    boundaries = {
+        e * steps_per_epoch: cfg.gamma
+        for e in range(cfg.step_size_epochs, epochs + 1, cfg.step_size_epochs)
+    }
+    if not boundaries:
+        return cfg.learning_rate
+    return optax.piecewise_constant_schedule(cfg.learning_rate, boundaries)
+
+
+def make_optimizer(cfg: OptimConfig, steps_per_epoch: int,
+                   epochs: int) -> optax.GradientTransformation:
+    schedule = lr_schedule(cfg, steps_per_epoch, epochs)
+    if cfg.name == "adam" and cfg.weight_decay == 0.0:
+        return optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+    if cfg.name in ("adam", "adamw"):
+        return optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                           weight_decay=cfg.weight_decay)
+    if cfg.name == "sgd":
+        return optax.sgd(schedule, momentum=0.9)
+    raise ValueError(f"unknown optimizer {cfg.name!r}")
+
+
+def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
+                       rng: jax.Array, *, image_size: int,
+                       steps_per_epoch: int, epochs: int) -> TrainState:
+    """Build model variables (optionally overlaying converted pretrained
+    torch weights, reference :137-139) and the optimizer state."""
+    model = create_model(model_cfg)
+    variables = init_variables(model, rng, image_size=image_size)
+    if model_cfg.pretrained_path:
+        variables = load_pretrained(model_cfg.pretrained_path, variables,
+                                    num_classes=model_cfg.num_classes)
+    tx = make_optimizer(optim_cfg, steps_per_epoch, epochs)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        tx=tx,
+    )
