@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Benchmark: MobileNetV2/CIFAR-10 training throughput per chip.
+
+Measures steady-state images/sec of the full jitted training step (raw
+uint8 32x32 batch in -> on-device augmentation -> forward -> backward ->
+Adam update -> metrics) on the reference workload shape (224x224, the
+reference's single-V100 config trains ~94.7 img/s, BASELINE.md). Prints
+ONE JSON line; vs_baseline is the ratio to that single-GPU baseline.
+
+Input batches are pre-staged on device and cycled with fresh RNG keys so
+the number measures the accelerator compute path; the real input path
+ships the same uint8 batches (3 KB/image), far below HBM/PCIe limits.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 94.7  # 1x V100, BASELINE.md ("north star" x4 target)
+
+
+def main() -> None:
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.data.cifar10 import synthetic_cifar10
+    from tpunet.parallel import shard_host_batch
+    from tpunet.train.loop import Trainer
+    from tpunet.utils.prng import step_key
+
+    n_chips = jax.device_count()
+    batch = 256 * n_chips
+    cfg = TrainConfig(
+        data=DataConfig(dataset="synthetic", batch_size=batch),
+        model=ModelConfig(),              # bf16 compute, 224px
+        optim=OptimConfig(),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    ds = synthetic_cifar10(n_train=4 * batch, n_test=batch)
+    trainer = Trainer(cfg, dataset=ds)
+
+    # Pre-staged device batches (cycled), fresh rng per step.
+    batches = []
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        x = rng.integers(0, 256, size=(batch, 32, 32, 3), dtype=np.uint8)
+        y = rng.integers(0, 10, size=batch).astype(np.int32)
+        batches.append(shard_host_batch(trainer.mesh, x, y))
+
+    state = trainer.state
+    step = trainer.train_step
+
+    warmup, timed = 5, 20
+    for i in range(warmup):
+        gx, gy = batches[i % len(batches)]
+        state, m = step(state, gx, gy, step_key(0, i))
+    jax.block_until_ready(m)
+
+    t0 = time.perf_counter()
+    for i in range(timed):
+        gx, gy = batches[i % len(batches)]
+        state, m = step(state, gx, gy, step_key(0, warmup + i))
+    jax.block_until_ready(m)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = timed * batch / dt
+    per_chip = img_per_sec / n_chips
+    print(json.dumps({
+        "metric": "train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

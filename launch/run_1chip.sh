@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Single-accelerator run — equivalent of the reference's run_gpu128.sh
+# (--gres=gpu:1, batch 128), on one TPU chip.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python train.py --preset single --mesh-data 1 "$@"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Serve the trained classifier (reference Gradio app, GROUP03.pdf
+# pp.22-23) on 0.0.0.0:7861.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m tpunet.infer.app --checkpoint-dir "${1:-checkpoints}"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Serial CPU run — equivalent of the reference's serial.slurm (1 task,
+# CPU only, batch 64). Forces the JAX CPU backend.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu python train.py --preset serial "$@"

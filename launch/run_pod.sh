@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Distributed data-parallel run — equivalent of the reference's
+# cifar10_gpu_parallel.sh (sbatch + mpirun -np 2). On a TPU VM or pod
+# slice there is no mpirun: the same command runs on every worker and
+# jax.distributed.initialize discovers the topology from the platform.
+#
+# Single TPU VM (all local chips):      ./launch/run_pod.sh
+# Multi-host pod slice (e.g. v5e-32), from a workstation:
+#   gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+#     --command "cd $REPO_DIR && ./launch/run_pod.sh"
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python train.py --preset distributed "$@"

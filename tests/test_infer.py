@@ -1,0 +1,82 @@
+"""Inference tests (reference C13/C14 parity: top-k, confidence
+threshold, checkpoint loading, serve-time preprocessing)."""
+
+import numpy as np
+import pytest
+
+from tpunet.config import CheckpointConfig, DataConfig, ModelConfig
+from tpunet.infer.predict import Predictor
+from tpunet.train.loop import Trainer
+
+from test_train import tiny_config, tiny_dataset  # noqa: F401
+
+SMALL_MODEL = ModelConfig(dtype="float32", width_mult=0.5)
+SMALL_DATA = DataConfig(image_size=32)
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    return Predictor(model_cfg=SMALL_MODEL, data_cfg=SMALL_DATA)
+
+
+def test_probs_sum_to_one(predictor):
+    img = np.random.default_rng(0).integers(
+        0, 256, size=(48, 64, 3), dtype=np.uint8)  # arbitrary input size
+    probs = predictor.predict_probs(img)
+    assert probs.shape == (10,)
+    assert np.isclose(probs.sum(), 1.0, atol=1e-5)
+
+
+def test_topk_ordering_and_threshold(predictor):
+    img = np.zeros((32, 32, 3), np.uint8)
+    res = predictor.predict(img, topk=3, conf_threshold=0.5)
+    assert len(res.topk) == 3
+    assert res.topk[0][1] >= res.topk[1][1] >= res.topk[2][1]
+    # Untrained model ~ uniform probs (~0.1 each) -> below 0.5 threshold.
+    assert res.uncertain and res.predicted == "uncertain"
+    # With threshold 0 the argmax class is reported.
+    res2 = predictor.predict(img, topk=3, conf_threshold=0.0)
+    assert not res2.uncertain
+    assert res2.predicted == res2.topk[0][0]
+
+
+def test_pil_and_float_inputs(predictor):
+    from PIL import Image
+    arr = np.random.default_rng(1).integers(
+        0, 256, size=(32, 32, 3), dtype=np.uint8)
+    p1 = predictor.predict_probs(Image.fromarray(arr))
+    p2 = predictor.predict_probs(arr)
+    p3 = predictor.predict_probs(arr.astype(np.float32) / 255.0)
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+    np.testing.assert_allclose(p1, p3, atol=0.02)  # uint8 quantization
+
+
+def test_predictor_loads_best_checkpoint(tmp_path, tiny_dataset):  # noqa: F811
+    cfg = tiny_config(tmp_path, epochs=1).replace(
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "ck")))
+    t = Trainer(cfg, dataset=tiny_dataset)
+    t.train()
+    t.ckpt.close()
+    pred = Predictor(model_cfg=cfg.model, data_cfg=cfg.data,
+                     checkpoint_dir=str(tmp_path / "ck"))
+    tp = np.asarray(t.state.params["classifier"]["kernel"])
+    pp = np.asarray(pred.variables["params"]["classifier"]["kernel"])
+    np.testing.assert_allclose(tp, pp)
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        Predictor(model_cfg=SMALL_MODEL, data_cfg=SMALL_DATA,
+                  checkpoint_dir=str(tmp_path / "nope"))
+
+
+def test_gradio_gated():
+    # gradio isn't installed here: the app module must fail with a clear
+    # ImportError, not crash at import time.
+    from tpunet.infer import app
+    pred = Predictor(model_cfg=SMALL_MODEL, data_cfg=SMALL_DATA)
+    try:
+        import gradio  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="gradio"):
+            app.build_interface(pred)

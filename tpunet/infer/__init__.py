@@ -1,0 +1,1 @@
+from tpunet.infer.predict import Predictor, PredictionResult  # noqa: F401

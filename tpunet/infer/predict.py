@@ -1,0 +1,125 @@
+"""Top-k inference with a confidence threshold.
+
+Parity with the reference's predict_cifar10_image()
+(cifar10_serial_mobilenet_224.py:159-188): image -> test transform
+(Resize(image_size) + ImageNet normalize) -> softmax -> top-k (default
+k=3) -> if the best probability is below conf_threshold (default 0.5) the
+prediction is flagged "uncertain". The forward pass is jitted once and
+reused across requests.
+
+The reference's Gradio app normalized with CIFAR-10 stats while training
+used ImageNet stats (train/serve skew, GROUP03.pdf p.22); here inference
+always reuses the training DataConfig stats, fixing that bug by
+construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpunet.ckpt import Checkpointer
+from tpunet.config import (CIFAR10_CLASSES, CheckpointConfig, DataConfig,
+                           ModelConfig)
+from tpunet.models.mobilenetv2 import create_model, init_variables
+
+
+@dataclasses.dataclass
+class PredictionResult:
+    predicted: str               # class name, or "uncertain"
+    confidence: float
+    uncertain: bool
+    topk: List[Tuple[str, float]]
+
+
+class Predictor:
+    """Loads (or receives) trained variables and serves top-k predictions."""
+
+    def __init__(self,
+                 model_cfg: Optional[ModelConfig] = None,
+                 data_cfg: Optional[DataConfig] = None,
+                 variables: Optional[dict] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 class_names: Sequence[str] = CIFAR10_CLASSES):
+        self.model_cfg = model_cfg or ModelConfig()
+        self.data_cfg = data_cfg or DataConfig()
+        self.class_names = tuple(class_names)
+        self.model = create_model(self.model_cfg)
+        if variables is None:
+            variables = init_variables(self.model, jax.random.PRNGKey(0),
+                                       image_size=self.data_cfg.image_size)
+            if checkpoint_dir:
+                ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
+                best = ckpt.restore_best({
+                    "params": variables["params"],
+                    "batch_stats": variables["batch_stats"]})
+                if best is None:
+                    raise FileNotFoundError(
+                        f"no best checkpoint under {checkpoint_dir!r}")
+                variables = best
+        self.variables = {"params": variables["params"],
+                          "batch_stats": variables["batch_stats"]}
+        size = self.data_cfg.image_size
+        mean = jnp.asarray(self.data_cfg.mean)
+        std = jnp.asarray(self.data_cfg.std)
+
+        def forward(variables, image_u8):
+            x = image_u8.astype(jnp.float32) / 255.0
+            x = jax.image.resize(x, (size, size, 3), method="bilinear")
+            x = (x - mean) / std
+            logits = self.model.apply(variables, x[None], train=False)
+            return jax.nn.softmax(logits[0])
+
+        self._forward = jax.jit(forward)
+
+    def predict_probs(self, image) -> np.ndarray:
+        """image: (H, W, 3) uint8 array or PIL.Image; returns class probs."""
+        if hasattr(image, "convert"):      # PIL image
+            image = np.asarray(image.convert("RGB"))
+        image = np.asarray(image)
+        if image.dtype != np.uint8:
+            image = np.clip(image * 255 if image.max() <= 1.0 else image,
+                            0, 255).astype(np.uint8)
+        return np.asarray(self._forward(self.variables, jnp.asarray(image)))
+
+    def predict(self, image, topk: int = 3,
+                conf_threshold: float = 0.5) -> PredictionResult:
+        probs = self.predict_probs(image)
+        order = np.argsort(probs)[::-1][:topk]
+        top = [(self.class_names[i], float(probs[i])) for i in order]
+        best_name, best_conf = top[0]
+        uncertain = best_conf < conf_threshold
+        return PredictionResult(
+            predicted="uncertain" if uncertain else best_name,
+            confidence=best_conf,
+            uncertain=uncertain,
+            topk=top,
+        )
+
+
+def main(argv=None):
+    import argparse
+
+    from PIL import Image
+
+    p = argparse.ArgumentParser(description="tpunet top-k inference")
+    p.add_argument("image", help="path to an image file")
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--topk", type=int, default=3)
+    p.add_argument("--conf-threshold", type=float, default=0.5)
+    args = p.parse_args(argv)
+    pred = Predictor(checkpoint_dir=args.checkpoint_dir)
+    result = pred.predict(Image.open(args.image), topk=args.topk,
+                          conf_threshold=args.conf_threshold)
+    print(f"Predicted: {result.predicted} "
+          f"(confidence {result.confidence:.4f})")
+    for name, prob in result.topk:
+        print(f"  {name}: {prob:.4f}")
+
+
+if __name__ == "__main__":
+    main()
