@@ -1,0 +1,67 @@
+"""Worker for the 2-process multi-controller test (run via subprocess).
+
+Boots jax.distributed against a localhost coordinator (the analogue of
+the reference's mpirun + localhost:29500 rendezvous,
+cifar10_mpi_mobilenet_224.py:28-35), builds the global mesh spanning both
+processes' virtual CPU devices, trains one epoch of the tiny synthetic
+workload, and prints metrics as JSON for the parent to compare.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    coordinator, num_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_procs,
+        process_id=pid,
+    )
+    assert jax.process_count() == num_procs
+    assert jax.device_count() == 4 * num_procs
+
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.data.cifar10 import synthetic_cifar10
+    from tpunet.parallel import sync_hosts
+    from tpunet.train.loop import Trainer
+
+    cfg = TrainConfig(
+        epochs=1, seed=42,
+        data=DataConfig(dataset="synthetic", image_size=32, batch_size=16,
+                        rrc_scale=(1.0, 1.0), rrc_ratio=(1.0, 1.0),
+                        jitter_brightness=0.0, jitter_contrast=0.0,
+                        jitter_saturation=0.0, jitter_hue=0.0,
+                        rotation_degrees=0.0),
+        model=ModelConfig(dtype="float32", width_mult=0.5),
+        optim=OptimConfig(learning_rate=1e-3),
+        mesh=MeshConfig(),  # all 8 global devices on the data axis
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    ds = synthetic_cifar10(n_train=64, n_test=32, seed=7)
+    trainer = Trainer(cfg, dataset=ds)
+    sync_hosts("start")
+    eval0 = trainer.evaluate()
+    train1 = trainer.train_one_epoch(0)
+    print(json.dumps({
+        "process": pid,
+        "world": jax.process_count(),
+        "devices": jax.device_count(),
+        "eval0": eval0,
+        "train1": train1,
+    }), flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

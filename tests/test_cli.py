@@ -1,0 +1,62 @@
+"""End-to-end CLI tests: config parsing and a full train.py run."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpunet.config import config_from_args
+
+REPO = os.path.dirname(os.path.dirname(__file__))
+
+
+def test_presets_match_reference_batch_sizes():
+    assert config_from_args(["--preset", "serial"]).data.batch_size == 64
+    assert config_from_args(["--preset", "single"]).data.batch_size == 128
+    cfg = config_from_args([])
+    assert cfg.epochs == 20 and cfg.seed == 42
+    assert cfg.optim.learning_rate == 1e-4
+    assert cfg.optim.step_size_epochs == 10 and cfg.optim.gamma == 0.1
+    assert cfg.data.image_size == 224
+
+
+def test_arg_overrides():
+    cfg = config_from_args([
+        "--preset", "serial", "--epochs", "2", "--batch-size", "32",
+        "--image-size", "64", "--lr", "0.01", "--dataset", "synthetic",
+        "--mesh-data", "4", "--dtype", "float32", "--resume",
+        "--checkpoint-dir", "/tmp/x"])
+    assert cfg.epochs == 2
+    assert cfg.data.batch_size == 32 and cfg.data.image_size == 64
+    assert cfg.optim.learning_rate == 0.01
+    assert cfg.mesh.data == 4
+    assert cfg.model.dtype == "float32"
+    assert cfg.checkpoint.resume and cfg.checkpoint.directory == "/tmp/x"
+
+
+@pytest.mark.slow
+def test_train_cli_end_to_end(tmp_path):
+    """python train.py on synthetic data: epoch lines in the reference
+    format, checkpoints written, exit code 0."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "train.py", "--preset", "distributed",
+         "--dataset", "synthetic", "--synthetic-size", "128",
+         "--epochs", "2", "--batch-size", "32", "--image-size", "32",
+         "--dtype", "float32", "--width-mult", "0.5",
+         "--checkpoint-dir", str(tmp_path / "ck")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=570)
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = out.stdout.splitlines()
+    epoch_lines = [l for l in lines if l.startswith("Epoch ")]
+    assert len(epoch_lines) == 2
+    assert "Train Loss:" in epoch_lines[0] and "Test Acc:" in epoch_lines[0]
+    assert any(l.startswith("Best test accuracy:") for l in lines)
+    assert any(l.startswith("Total training time:") for l in lines)
+    assert (tmp_path / "ck" / "state").is_dir()

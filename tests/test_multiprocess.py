@@ -1,0 +1,75 @@
+"""True 2-process multi-controller training test.
+
+TPU-native equivalent of the reference's no-cluster validation path
+(`mpirun -np 2` with the gloo backend, cifar10_mpi_mobilenet_224.py:34,
+41-43; SURVEY.md section 4 point 3): two separate JAX processes
+rendezvous over a localhost coordinator, form one 8-device global mesh
+(4 virtual CPU devices each), and train the same tiny workload. Checks:
+both controllers report identical *global* metrics, and those metrics
+match a single-process run on the same global mesh.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_training_parity():
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "_mp_worker.py"),
+             coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=REPO)
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    a, b = outs
+    assert a["world"] == b["world"] == 2
+    assert a["devices"] == b["devices"] == 8
+    # Global metrics identical on both controllers (same psum results).
+    for section in ("eval0", "train1"):
+        assert np.isclose(a[section]["loss"], b[section]["loss"], rtol=1e-6)
+        assert a[section]["count"] == b[section]["count"]
+        assert np.isclose(a[section]["accuracy"], b[section]["accuracy"],
+                          atol=1e-9)
+
+    # And they match a single-process run of the same global computation
+    # (init-time eval is tight; train epoch is loose per Adam noise).
+    from tpunet.config import MeshConfig
+    from tpunet.data.cifar10 import synthetic_cifar10
+    from tpunet.train.loop import Trainer
+    from test_train import tiny_config
+
+    cfg = tiny_config(os.path.join(REPO, "/tmp"), batch=16, epochs=1)
+    t = Trainer(cfg, dataset=synthetic_cifar10(n_train=64, n_test=32, seed=7))
+    e = t.evaluate()
+    assert e["count"] == a["eval0"]["count"]
+    assert np.isclose(e["loss"], a["eval0"]["loss"], rtol=1e-4)
+    m = t.train_one_epoch(0)
+    assert np.isclose(m["loss"], a["train1"]["loss"], rtol=2e-2)

@@ -50,6 +50,9 @@ class DataConfig:
     rotation_degrees: float = 15.0
     mean: Tuple[float, float, float] = IMAGENET_MEAN
     std: Tuple[float, float, float] = IMAGENET_STD
+    # Synthetic-dataset sizes (CIFAR-10-shaped stand-in for hermetic runs).
+    synthetic_train_size: int = 50_000
+    synthetic_test_size: int = 10_000
     # Deviation from torch DistributedSampler (which pads shards to equal
     # length, :119-124): we drop the train remainder and evaluate the test
     # set exactly (padding with masked examples), which also fixes the
@@ -176,6 +179,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--dataset", default=None, choices=["cifar10", "synthetic"])
     p.add_argument("--pretrained", default=None,
                    help="path to a torch MobileNetV2 state_dict to convert")
+    p.add_argument("--width-mult", type=float, default=None)
+    p.add_argument("--synthetic-size", type=int, default=None,
+                   help="train-set size when --dataset synthetic")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--mesh-data", type=int, default=None)
@@ -197,8 +203,14 @@ def config_from_args(argv=None) -> TrainConfig:
         data = dataclasses.replace(data, data_dir=args.data_dir)
     if args.dataset is not None:
         data = dataclasses.replace(data, dataset=args.dataset)
+    if args.synthetic_size is not None:
+        data = dataclasses.replace(
+            data, synthetic_train_size=args.synthetic_size,
+            synthetic_test_size=max(1, args.synthetic_size // 4))
     if args.pretrained is not None:
         model = dataclasses.replace(model, pretrained_path=args.pretrained)
+    if args.width_mult is not None:
+        model = dataclasses.replace(model, width_mult=args.width_mult)
     if args.dtype is not None:
         model = dataclasses.replace(model, dtype=args.dtype)
     if args.lr is not None:

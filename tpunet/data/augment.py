@@ -108,7 +108,9 @@ def _rotate_bilinear(img, angle):
 # Color jitter (torchvision-strength ops, fixed order)
 # ---------------------------------------------------------------------------
 
-_GRAY = jnp.asarray([0.299, 0.587, 0.114])
+# Plain numpy: a module-level jnp constant would initialize the XLA
+# backend at import time, breaking jax.distributed.initialize ordering.
+_GRAY = np.asarray([0.299, 0.587, 0.114], np.float32)
 
 
 def _rgb_to_hsv(x):

@@ -87,7 +87,8 @@ def synthetic_cifar10(n_train: int = 50_000, n_test: int = 10_000,
 
 def get_dataset(cfg: DataConfig) -> Arrays:
     if cfg.dataset == "synthetic":
-        return synthetic_cifar10()
+        return synthetic_cifar10(n_train=cfg.synthetic_train_size,
+                                 n_test=cfg.synthetic_test_size)
     if cfg.dataset == "cifar10":
         return load_cifar10(cfg.data_dir)
     raise ValueError(f"unknown dataset {cfg.dataset!r}")

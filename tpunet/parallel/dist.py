@@ -34,7 +34,12 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     configured = (coordinator_address or num_processes
                   or env.get("JAX_COORDINATOR_ADDRESS")
                   or env.get("JAX_NUM_PROCESSES"))
-    on_tpu_pod = env.get("TPU_WORKER_HOSTNAMES") or env.get("MEGASCALE_COORDINATOR_ADDRESS")
+    # Multi-host TPU pod: TPU_WORKER_HOSTNAMES lists >1 worker. (A
+    # single-host TPU VM also sets the variable; initialize() is neither
+    # needed nor safe there if the backend was already touched.)
+    workers = env.get("TPU_WORKER_HOSTNAMES", "")
+    on_tpu_pod = ("," in workers
+                  or env.get("MEGASCALE_COORDINATOR_ADDRESS"))
     if not (configured or on_tpu_pod):
         return
     jax.distributed.initialize(
