@@ -1,0 +1,186 @@
+// tpunet native host-side batch assembly.
+//
+// The reference's host data path is torch DataLoader worker processes
+// (cifar10_mpi_mobilenet_224.py:126-133, num_workers=2) doing PIL/CPU
+// transforms. In tpunet augmentation runs on-device inside the jitted
+// step, so the only host work per step is assembling this host's slice
+// of the global batch: a permutation gather over the in-RAM uint8
+// dataset. This library is the native runtime for that path — a
+// multithreaded row gather plus a background prefetcher that keeps a
+// ring of ready batches ahead of the device, replacing DataLoader
+// workers with threads in one address space (no pickling, no fork).
+//
+// Built as a plain C ABI shared library; Python binds via ctypes
+// (tpunet/data/native.py) with a pure-numpy fallback when the toolchain
+// is unavailable.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void gather_range(const uint8_t* src, const int64_t* idx, int64_t begin,
+                  int64_t end, int64_t row_bytes, uint8_t* out) {
+  for (int64_t i = begin; i < end; ++i) {
+    std::memcpy(out + i * row_bytes, src + idx[i] * row_bytes,
+                static_cast<size_t>(row_bytes));
+  }
+}
+
+void gather_rows_impl(const uint8_t* src, const int64_t* idx, int64_t n_idx,
+                      int64_t row_bytes, uint8_t* out, int n_threads) {
+  if (n_threads <= 1 || n_idx < 2 * n_threads) {
+    gather_range(src, idx, 0, n_idx, row_bytes, out);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  const int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    const int64_t b = t * chunk;
+    const int64_t e = std::min(n_idx, b + chunk);
+    if (b >= e) break;
+    pool.emplace_back(gather_range, src, idx, b, e, row_bytes, out);
+  }
+  for (auto& th : pool) th.join();
+}
+
+struct Batch {
+  std::vector<uint8_t> images;
+  std::vector<int32_t> labels;
+};
+
+// Background prefetcher: one worker thread assembles batches following
+// the epoch's index order into a bounded ring; consumers pop in order.
+class Prefetcher {
+ public:
+  Prefetcher(const uint8_t* images, const int32_t* labels, int64_t n_rows,
+             int64_t row_bytes, int64_t local_batch, int depth,
+             int n_threads)
+      : images_(images),
+        labels_(labels),
+        n_rows_(n_rows),
+        row_bytes_(row_bytes),
+        local_batch_(local_batch),
+        depth_(depth < 1 ? 1 : depth),
+        n_threads_(n_threads < 1 ? 1 : n_threads) {}
+
+  ~Prefetcher() { stop(); }
+
+  // Returns 0 on success, -1 if any index is out of range (the epoch is
+  // then not started — failing cleanly instead of a wild memcpy).
+  int start_epoch(const int64_t* idx, int64_t n_idx) {
+    for (int64_t i = 0; i < n_idx; ++i) {
+      if (idx[i] < 0 || idx[i] >= n_rows_) return -1;
+    }
+    stop();
+    idx_.assign(idx, idx + n_idx);
+    n_batches_ = n_idx / local_batch_;  // drop remainder, like the pipeline
+    consumed_ = 0;
+    stopping_ = false;
+    worker_ = std::thread(&Prefetcher::run, this);
+    return 0;
+  }
+
+  // 0 = batch copied out; 1 = epoch exhausted.
+  int next(uint8_t* out_images, int32_t* out_labels) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (consumed_ >= n_batches_) return 1;
+    ready_cv_.wait(lk, [&] { return !ring_.empty(); });
+    Batch b = std::move(ring_.front());
+    ring_.pop_front();
+    ++consumed_;
+    lk.unlock();
+    space_cv_.notify_one();
+    std::memcpy(out_images, b.images.data(), b.images.size());
+    std::memcpy(out_labels, b.labels.data(),
+                b.labels.size() * sizeof(int32_t));
+    return 0;
+  }
+
+ private:
+  void run() {
+    for (int64_t s = 0; s < n_batches_; ++s) {
+      Batch b;
+      b.images.resize(static_cast<size_t>(local_batch_ * row_bytes_));
+      b.labels.resize(static_cast<size_t>(local_batch_));
+      const int64_t* idx = idx_.data() + s * local_batch_;
+      gather_rows_impl(images_, idx, local_batch_, row_bytes_,
+                       b.images.data(), n_threads_);
+      for (int64_t i = 0; i < local_batch_; ++i) b.labels[i] = labels_[idx[i]];
+      std::unique_lock<std::mutex> lk(mu_);
+      space_cv_.wait(lk, [&] {
+        return stopping_ || static_cast<int>(ring_.size()) < depth_;
+      });
+      if (stopping_) return;
+      ring_.push_back(std::move(b));
+      lk.unlock();
+      ready_cv_.notify_one();
+    }
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stopping_ = true;
+    }
+    space_cv_.notify_all();
+    if (worker_.joinable()) worker_.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    ring_.clear();
+  }
+
+  const uint8_t* images_;
+  const int32_t* labels_;
+  int64_t n_rows_;
+  int64_t row_bytes_;
+  int64_t local_batch_;
+  int depth_;
+  int n_threads_;
+
+  std::vector<int64_t> idx_;
+  int64_t n_batches_ = 0;
+  int64_t consumed_ = 0;
+  bool stopping_ = false;
+  std::deque<Batch> ring_;
+  std::mutex mu_;
+  std::condition_variable ready_cv_;
+  std::condition_variable space_cv_;
+  std::thread worker_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void tn_gather_rows(const uint8_t* src, const int64_t* idx, int64_t n_idx,
+                    int64_t row_bytes, uint8_t* out, int n_threads) {
+  gather_rows_impl(src, idx, n_idx, row_bytes, out, n_threads);
+}
+
+void* tn_prefetcher_create(const uint8_t* images, const int32_t* labels,
+                           int64_t n_rows, int64_t row_bytes,
+                           int64_t local_batch, int depth, int n_threads) {
+  return new Prefetcher(images, labels, n_rows, row_bytes, local_batch, depth,
+                        n_threads);
+}
+
+int tn_prefetcher_start_epoch(void* p, const int64_t* idx, int64_t n_idx) {
+  return static_cast<Prefetcher*>(p)->start_epoch(idx, n_idx);
+}
+
+int tn_prefetcher_next(void* p, uint8_t* out_images, int32_t* out_labels) {
+  return static_cast<Prefetcher*>(p)->next(out_images, out_labels);
+}
+
+void tn_prefetcher_destroy(void* p) { delete static_cast<Prefetcher*>(p); }
+
+int tn_abi_version() { return 1; }
+
+}  // extern "C"

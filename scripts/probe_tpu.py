@@ -1,0 +1,81 @@
+"""Stagewise TPU compile/runtime probe (diagnostic; not part of bench)."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def stamp(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    stamp(f"devices: {jax.devices()} batch={batch}")
+
+    from tpunet.config import DataConfig, ModelConfig, OptimConfig
+    from tpunet.data.augment import make_eval_preprocess, make_train_augment
+    from tpunet.models.mobilenetv2 import create_model, init_variables
+
+    x8 = np.random.default_rng(0).integers(
+        0, 256, size=(batch, 32, 32, 3), dtype=np.uint8)
+    dcfg = DataConfig(batch_size=batch)
+
+    # Stage 1: eval preprocess (static resize matmuls)
+    pre = jax.jit(make_eval_preprocess(dcfg))
+    t = time.perf_counter()
+    out = pre(x8)
+    jax.block_until_ready(out)
+    stamp(f"eval preprocess compile+run: {time.perf_counter()-t:.1f}s")
+    t = time.perf_counter()
+    jax.block_until_ready(pre(x8))
+    stamp(f"eval preprocess steady: {(time.perf_counter()-t)*1e3:.1f}ms")
+
+    # Stage 2: train augmentation (rotate gather + dynamic matrices)
+    aug = jax.jit(make_train_augment(dcfg))
+    key = jax.random.PRNGKey(0)
+    t = time.perf_counter()
+    out = aug(key, x8)
+    jax.block_until_ready(out)
+    stamp(f"train augment compile+run: {time.perf_counter()-t:.1f}s")
+    t = time.perf_counter()
+    jax.block_until_ready(aug(key, x8))
+    stamp(f"train augment steady: {(time.perf_counter()-t)*1e3:.1f}ms")
+
+    # Stage 3: model forward (inference)
+    mcfg = ModelConfig()
+    model = create_model(mcfg)
+    variables = init_variables(model, jax.random.PRNGKey(0), image_size=224)
+    fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
+    xi = jnp.asarray(out)
+    t = time.perf_counter()
+    logits = fwd(variables, xi)
+    jax.block_until_ready(logits)
+    stamp(f"fwd compile+run: {time.perf_counter()-t:.1f}s")
+    t = time.perf_counter()
+    jax.block_until_ready(fwd(variables, xi))
+    stamp(f"fwd steady: {(time.perf_counter()-t)*1e3:.1f}ms")
+
+    # Stage 4: full train step (no mesh; single chip)
+    from tpunet.train.state import create_train_state
+    from tpunet.train.steps import make_train_step
+    state = create_train_state(mcfg, OptimConfig(), jax.random.PRNGKey(0),
+                               image_size=224, steps_per_epoch=100, epochs=20)
+    step = jax.jit(make_train_step(dcfg, OptimConfig()), donate_argnums=0)
+    y = np.zeros((batch,), np.int32)
+    t = time.perf_counter()
+    state, m = step(state, x8, y, key)
+    jax.block_until_ready(m)
+    stamp(f"train step compile+run: {time.perf_counter()-t:.1f}s")
+    for i in range(3):
+        t = time.perf_counter()
+        state, m = step(state, x8, y, jax.random.PRNGKey(i))
+        jax.block_until_ready(m)
+        stamp(f"train step steady: {(time.perf_counter()-t)*1e3:.1f}ms "
+              f"({batch/(time.perf_counter()-t):.0f} img/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Native C++ host-side batcher tests (cxx/batcher.cc via ctypes).
+
+The native path must be bit-identical to the numpy fallback: the gather
+is the TPU-native replacement for the reference's DataLoader worker
+processes (cifar10_mpi_mobilenet_224.py:126-133) and feeds raw uint8
+batches to the on-device augmentation.
+"""
+
+import numpy as np
+import pytest
+
+from tpunet.data import native
+from tpunet.data.pipeline import host_index_sequence, train_batches
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native batcher not built (no g++?)")
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 256, size=(997, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, 10, size=997).astype(np.int32)
+    return x, y
+
+
+def test_gather_rows_matches_numpy(data):
+    x, _ = data
+    idx = np.random.default_rng(0).permutation(len(x))[:300]
+    np.testing.assert_array_equal(native.gather_rows(x, idx), x[idx])
+
+
+def test_gather_rows_single_thread(data):
+    x, _ = data
+    idx = np.asarray([5, 5, 0, 996], dtype=np.int64)
+    np.testing.assert_array_equal(
+        native.gather_rows(x, idx, n_threads=1), x[idx])
+
+
+def test_prefetcher_matches_python_pipeline(data):
+    x, y = data
+    gb = 64
+    pf = native.NativePrefetcher(x, y, local_batch=gb, depth=2, n_threads=2)
+    for epoch in (0, 1):
+        idx = host_index_sequence(len(x), global_batch=gb, seed=42,
+                                  epoch=epoch)
+        got = list(pf.iter_epoch(idx))
+        want = list(train_batches(x, y, global_batch=gb, seed=42,
+                                  epoch=epoch))
+        assert len(got) == len(want) == len(x) // gb
+        for (gx, gy), (wx, wy) in zip(got, want):
+            np.testing.assert_array_equal(gx, wx)
+            np.testing.assert_array_equal(gy, wy)
+    pf.close()
+
+
+def test_prefetcher_multi_host_slices(data):
+    x, y = data
+    gb = 32
+    seqs = [host_index_sequence(len(x), global_batch=gb, seed=1, epoch=4,
+                                process_index=p, process_count=2)
+            for p in range(2)]
+    pf = native.NativePrefetcher(x, y, local_batch=gb // 2)
+    per_host = [list(pf.iter_epoch(s)) for s in seqs]
+    pf.close()
+    want = list(train_batches(x, y, global_batch=gb, seed=1, epoch=4))
+    for s, (wx, wy) in enumerate(want):
+        gx = np.concatenate([per_host[p][s][0] for p in range(2)])
+        gy = np.concatenate([per_host[p][s][1] for p in range(2)])
+        np.testing.assert_array_equal(gx, wx)
+        np.testing.assert_array_equal(gy, wy)

@@ -58,6 +58,10 @@ class DataConfig:
     # set exactly (padding with masked examples), which also fixes the
     # reference's rank-local-accuracy wart (:196,224).
     drop_remainder: bool = True
+    # Host-side batch assembly through the native C++ prefetcher
+    # (cxx/batcher.cc) when its shared library is buildable; falls back
+    # to the pure-numpy path silently otherwise.
+    native_loader: bool = True
 
     @property
     def effective_eval_batch_size(self) -> int:
@@ -188,6 +192,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-model", type=int, default=None)
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
     p.add_argument("--profile-dir", default=None)
+    p.add_argument("--no-native-loader", action="store_true",
+                   help="force the pure-numpy host batch path")
     return p
 
 
@@ -203,6 +209,8 @@ def config_from_args(argv=None) -> TrainConfig:
         data = dataclasses.replace(data, data_dir=args.data_dir)
     if args.dataset is not None:
         data = dataclasses.replace(data, dataset=args.dataset)
+    if args.no_native_loader:
+        data = dataclasses.replace(data, native_loader=False)
     if args.synthetic_size is not None:
         data = dataclasses.replace(
             data, synthetic_train_size=args.synthetic_size,

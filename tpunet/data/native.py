@@ -1,0 +1,185 @@
+"""ctypes bindings for the native host-side batch assembler (cxx/batcher.cc).
+
+The reference's host data path is DataLoader worker *processes*
+(cifar10_mpi_mobilenet_224.py:126-133); tpunet's device-side augmentation
+leaves only a permutation gather on the host, which this C++ library does
+with threads in-process and prefetches ahead of the device. Everything
+degrades gracefully: if the shared library is missing and no C++
+toolchain is available, callers fall back to numpy fancy indexing.
+
+Build: ``make -C cxx`` (or automatic on first import when g++ exists).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SRC = os.path.join(_REPO, "cxx", "batcher.cc")
+_LIB_DIR = os.path.join(_HERE, "_lib")
+_LIB = os.path.join(_LIB_DIR, "libtnbatcher.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    # Compile to a private temp file and rename into place: atomic under
+    # POSIX, so concurrent processes (multi-controller tests) never dlopen
+    # a partially written library.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-Wall", "-Werror=return-type",
+           "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _stale() -> bool:
+    try:
+        return os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+    except OSError:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if (not os.path.exists(_LIB) or _stale()) and not _build():
+            if not os.path.exists(_LIB):
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.tn_gather_rows.argtypes = [u8p, i64p, ctypes.c_int64,
+                                       ctypes.c_int64, u8p, ctypes.c_int]
+        lib.tn_gather_rows.restype = None
+        lib.tn_prefetcher_create.argtypes = [u8p, i32p, ctypes.c_int64,
+                                             ctypes.c_int64, ctypes.c_int64,
+                                             ctypes.c_int, ctypes.c_int]
+        lib.tn_prefetcher_create.restype = ctypes.c_void_p
+        lib.tn_prefetcher_start_epoch.argtypes = [ctypes.c_void_p, i64p,
+                                                  ctypes.c_int64]
+        lib.tn_prefetcher_start_epoch.restype = ctypes.c_int
+        lib.tn_prefetcher_next.argtypes = [ctypes.c_void_p, u8p, i32p]
+        lib.tn_prefetcher_next.restype = ctypes.c_int
+        lib.tn_prefetcher_destroy.argtypes = [ctypes.c_void_p]
+        lib.tn_prefetcher_destroy.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _as_i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _as_i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                n_threads: int = 4) -> np.ndarray:
+    """out[i] = src[idx[i]] over the leading axis (uint8 arrays).
+
+    Multithreaded native memcpy when the library is available, else numpy
+    fancy indexing — bit-identical either way.
+    """
+    if src.dtype != np.uint8:
+        raise TypeError(f"gather_rows expects uint8 rows, got {src.dtype}")
+    lib = _load()
+    src = np.ascontiguousarray(src)
+    if lib is None:
+        return src[idx]
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], dtype=np.uint8)
+    row_bytes = int(np.prod(src.shape[1:], dtype=np.int64))
+    lib.tn_gather_rows(_as_u8p(src), _as_i64p(idx), len(idx), row_bytes,
+                       _as_u8p(out), n_threads)
+    return out
+
+
+class NativePrefetcher:
+    """Background-thread batch assembly over an in-RAM uint8 dataset.
+
+    Owns references to ``images``/``labels`` for its lifetime (the C++
+    side reads their buffers directly, zero-copy).
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 local_batch: int, depth: int = 4, n_threads: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native batcher unavailable")
+        if images.dtype != np.uint8:
+            raise TypeError(
+                f"NativePrefetcher expects uint8 images, got {images.dtype}")
+        self._lib = lib
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.ascontiguousarray(labels, dtype=np.int32)
+        self.local_batch = int(local_batch)
+        self.row_shape = self.images.shape[1:]
+        row_bytes = int(np.prod(self.row_shape, dtype=np.int64))
+        self._handle = lib.tn_prefetcher_create(
+            _as_u8p(self.images), _as_i32p(self.labels), len(self.images),
+            row_bytes, self.local_batch, depth, n_threads)
+        self._idx: Optional[np.ndarray] = None   # keep alive for C++ reads
+
+    def iter_epoch(self, idx: np.ndarray) -> Iterator[
+            Tuple[np.ndarray, np.ndarray]]:
+        """Yield (images[local_batch, ...], labels) following ``idx``."""
+        self._idx = np.ascontiguousarray(idx, dtype=np.int64)
+        if self._lib.tn_prefetcher_start_epoch(
+                self._handle, _as_i64p(self._idx), len(self._idx)):
+            raise IndexError("prefetcher index out of range for dataset")
+        while True:
+            x = np.empty((self.local_batch,) + self.row_shape, np.uint8)
+            y = np.empty((self.local_batch,), np.int32)
+            if self._lib.tn_prefetcher_next(self._handle, _as_u8p(x),
+                                            _as_i32p(y)):
+                return
+            yield x, y
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tn_prefetcher_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

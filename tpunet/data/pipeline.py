@@ -31,6 +31,25 @@ def steps_per_epoch(n: int, global_batch: int) -> int:
     return n // global_batch
 
 
+def host_index_sequence(n: int, *, global_batch: int, seed: int, epoch: int,
+                        process_index: int = 0,
+                        process_count: int = 1) -> np.ndarray:
+    """This host's full index order for an epoch (concatenated per-step
+    slices of the global permutation) — the feed for the native prefetcher."""
+    if global_batch % process_count:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"{process_count} processes")
+    local = global_batch // process_count
+    perm = _epoch_permutation(n, seed, epoch)
+    n_steps = steps_per_epoch(n, global_batch)
+    parts = [perm[s * global_batch + process_index * local:
+                  s * global_batch + process_index * local + local]
+             for s in range(n_steps)]
+    return (np.concatenate(parts) if parts
+            else np.empty((0,), dtype=perm.dtype))
+
+
 def train_batches(
     images: np.ndarray,
     labels: np.ndarray,

@@ -59,6 +59,14 @@ class Trainer:
             make_eval_step(cfg.data),
             in_shardings=(repl, bsh, bsh, bsh))
 
+        self._prefetcher = None
+        if cfg.data.native_loader:
+            from tpunet.data import native
+            if native.available():
+                local = cfg.data.batch_size // jax.process_count()
+                self._prefetcher = native.NativePrefetcher(
+                    self.train_x, self.train_y.astype(np.int32), local)
+
         self.ckpt = Checkpointer(cfg.checkpoint)
         self.global_step = 0
         self.start_epoch = 1
@@ -90,15 +98,27 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
+    def _epoch_batches(self, epoch: int):
+        cfg = self.cfg
+        if self._prefetcher is not None:
+            from tpunet.data.pipeline import host_index_sequence
+            idx = host_index_sequence(
+                len(self.train_x), global_batch=cfg.data.batch_size,
+                seed=cfg.seed, epoch=epoch,
+                process_index=jax.process_index(),
+                process_count=jax.process_count())
+            return self._prefetcher.iter_epoch(idx)
+        return train_batches(
+            self.train_x, self.train_y,
+            global_batch=cfg.data.batch_size,
+            seed=cfg.seed, epoch=epoch,
+            process_index=jax.process_index(),
+            process_count=jax.process_count())
+
     def train_one_epoch(self, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
         acc = None
-        for bx, by in train_batches(
-                self.train_x, self.train_y,
-                global_batch=cfg.data.batch_size,
-                seed=cfg.seed, epoch=epoch,
-                process_index=jax.process_index(),
-                process_count=jax.process_count()):
+        for bx, by in self._epoch_batches(epoch):
             rng = step_key(cfg.seed, self.global_step)
             gx, gy = shard_host_batch(self.mesh, bx, by.astype(np.int32))
             self.state, m = self.train_step(self.state, gx, gy, rng)
@@ -128,6 +148,8 @@ class Trainer:
         log0(f"Test samples: {len(self.test_x)}")
         from tpunet.models.mobilenetv2 import num_params
         log0(f"Total parameters: {num_params(self.state.params)}")
+        log0("Host loader: " + ("native C++ prefetcher"
+                                if self._prefetcher is not None else "numpy"))
         log0("Starting training...")
         log0("")
         total = Timer()
@@ -160,3 +182,9 @@ class Trainer:
             log0(line)
         self.ckpt.wait()
         return self.history
+
+    def close(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        self.ckpt.close()

@@ -53,7 +53,7 @@ def main(argv=None) -> int:
     trainer.train()
     if cfg.profile_dir:
         jax.profiler.stop_trace()
-    trainer.ckpt.close()
+    trainer.close()
     return 0
 
 
