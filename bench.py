@@ -15,12 +15,24 @@ ships the same uint8 batches (3 KB/image), far below HBM/PCIe limits.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+# Persistent compiled-program cache: TPU compiles in this environment go
+# through a slow remote-compile relay, so cache hits across runs matter.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
 
 import jax
 import numpy as np
 
 BASELINE_IMG_PER_SEC = 94.7  # 1x V100, BASELINE.md ("north star" x4 target)
+
+
+def _note(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
 
 
 def main() -> None:
@@ -54,11 +66,15 @@ def main() -> None:
     state = trainer.state
     step = trainer.train_step
 
-    warmup, timed = 5, 20
+    warmup, timed = 3, 12
+    _note(f"compiling + warming up ({jax.devices()[0].platform}, "
+          f"batch {batch})...")
+    t0 = time.perf_counter()
     for i in range(warmup):
         gx, gy = batches[i % len(batches)]
         state, m = step(state, gx, gy, step_key(0, i))
     jax.block_until_ready(m)
+    _note(f"warmup done in {time.perf_counter()-t0:.1f}s")
 
     t0 = time.perf_counter()
     for i in range(timed):

@@ -83,6 +83,10 @@ class ModelConfig:
     # weights to convert (transfer learning is load-bearing for the ~96%
     # accuracy target — reference README.md:24-26).
     pretrained_path: Optional[str] = None
+    # Route 3x3 depthwise convs through the Pallas kernel (tpunet/ops/);
+    # parameter trees are identical either way, so the flag can be
+    # flipped on existing checkpoints.
+    use_pallas_depthwise: bool = False
 
 
 @dataclass(frozen=True)
@@ -194,6 +198,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--no-native-loader", action="store_true",
                    help="force the pure-numpy host batch path")
+    p.add_argument("--pallas-depthwise", action="store_true",
+                   help="route 3x3 depthwise convs through the Pallas kernel")
     return p
 
 
@@ -219,6 +225,8 @@ def config_from_args(argv=None) -> TrainConfig:
         model = dataclasses.replace(model, pretrained_path=args.pretrained)
     if args.width_mult is not None:
         model = dataclasses.replace(model, width_mult=args.width_mult)
+    if args.pallas_depthwise:
+        model = dataclasses.replace(model, use_pallas_depthwise=True)
     if args.dtype is not None:
         model = dataclasses.replace(model, dtype=args.dtype)
     if args.lr is not None:

@@ -31,15 +31,21 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
+# Must match tn_abi_version() in cxx/batcher.cc; bump both together.
+_ABI_VERSION = 1
+
+
 def _build() -> bool:
     if not os.path.exists(_SRC):
         return False
     os.makedirs(_LIB_DIR, exist_ok=True)
     # Compile to a private temp file and rename into place: atomic under
     # POSIX, so concurrent processes (multi-controller tests) never dlopen
-    # a partially written library.
+    # a partially written library. One source of truth for flags: $CXX
+    # like the Makefile, defaulting to g++.
     tmp = f"{_LIB}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-Wall", "-Werror=return-type",
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-std=c++17", "-Wall", "-Werror=return-type",
            "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -72,6 +78,16 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_LIB)
         except OSError:
+            _load_failed = True
+            return None
+        # Refuse a library whose C ABI doesn't match these bindings
+        # (e.g. a stale .so left behind when a rebuild failed).
+        try:
+            lib.tn_abi_version.restype = ctypes.c_int
+            abi = lib.tn_abi_version()
+        except AttributeError:
+            abi = -1
+        if abi != _ABI_VERSION:
             _load_failed = True
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)

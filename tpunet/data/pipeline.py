@@ -43,11 +43,11 @@ def host_index_sequence(n: int, *, global_batch: int, seed: int, epoch: int,
     local = global_batch // process_count
     perm = _epoch_permutation(n, seed, epoch)
     n_steps = steps_per_epoch(n, global_batch)
-    parts = [perm[s * global_batch + process_index * local:
-                  s * global_batch + process_index * local + local]
-             for s in range(n_steps)]
-    return (np.concatenate(parts) if parts
-            else np.empty((0,), dtype=perm.dtype))
+    # Step s gives this host rows [s*gb + pi*local, s*gb + (pi+1)*local):
+    # i.e. column `process_index` of the (steps, processes, local) view.
+    return (perm[:n_steps * global_batch]
+            .reshape(n_steps, process_count, local)[:, process_index]
+            .reshape(-1))
 
 
 def train_batches(

@@ -57,6 +57,28 @@ def _make_divisible(v: float, divisor: int = 8) -> int:
     return new_v
 
 
+class PallasDepthwise(nn.Module):
+    """3x3 depthwise conv through the Pallas kernel (tpunet.ops).
+
+    Parameter name/shape ('kernel', (3, 3, 1, C)) matches nn.Conv with
+    feature_group_count=C exactly, so checkpoints and converted torch
+    weights are interchangeable between the two paths.
+    """
+
+    features: int
+    stride: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from tpunet.ops import depthwise_conv3x3
+        kernel = self.param("kernel", conv_init, (3, 3, 1, self.features),
+                            self.param_dtype)
+        w = kernel[:, :, 0, :].astype(self.dtype)
+        return depthwise_conv3x3(x.astype(self.dtype), w, self.stride)
+
+
 class ConvBN(nn.Module):
     """Conv + BatchNorm (+ optional ReLU6), the MobileNetV2 building unit."""
 
@@ -65,24 +87,30 @@ class ConvBN(nn.Module):
     stride: int = 1
     groups: int = 1
     act: bool = True
+    use_pallas: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         pad = (self.kernel - 1) // 2
-        x = nn.Conv(
-            self.features,
-            (self.kernel, self.kernel),
-            strides=(self.stride, self.stride),
-            padding=((pad, pad), (pad, pad)),
-            feature_group_count=self.groups,
-            use_bias=False,
-            kernel_init=conv_init,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="conv",
-        )(x)
+        if (self.use_pallas and self.kernel == 3 and self.groups > 1
+                and self.groups == self.features == x.shape[-1]):
+            x = PallasDepthwise(self.features, self.stride, dtype=self.dtype,
+                                param_dtype=self.param_dtype, name="conv")(x)
+        else:
+            x = nn.Conv(
+                self.features,
+                (self.kernel, self.kernel),
+                strides=(self.stride, self.stride),
+                padding=((pad, pad), (pad, pad)),
+                feature_group_count=self.groups,
+                use_bias=False,
+                kernel_init=conv_init,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="conv",
+            )(x)
         x = nn.BatchNorm(
             use_running_average=not train,
             momentum=0.9,
@@ -102,6 +130,7 @@ class InvertedResidual(nn.Module):
     features: int
     stride: int
     expand_ratio: int
+    use_pallas: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -114,7 +143,8 @@ class InvertedResidual(nn.Module):
             y = ConvBN(hidden, kernel=1, dtype=self.dtype,
                        param_dtype=self.param_dtype, name="expand")(y, train)
         y = ConvBN(hidden, kernel=3, stride=self.stride, groups=hidden,
-                   dtype=self.dtype, param_dtype=self.param_dtype,
+                   use_pallas=self.use_pallas, dtype=self.dtype,
+                   param_dtype=self.param_dtype,
                    name="depthwise")(y, train)
         y = ConvBN(self.features, kernel=1, act=False, dtype=self.dtype,
                    param_dtype=self.param_dtype, name="project")(y, train)
@@ -134,6 +164,7 @@ class MobileNetV2(nn.Module):
     num_classes: int = 10
     width_mult: float = 1.0
     dropout_rate: float = 0.2
+    use_pallas: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -149,6 +180,7 @@ class MobileNetV2(nn.Module):
             for i in range(n):
                 x = InvertedResidual(
                     out_ch, stride=s if i == 0 else 1, expand_ratio=t,
+                    use_pallas=self.use_pallas,
                     dtype=self.dtype, param_dtype=self.param_dtype,
                     name=f"block{idx:02d}")(x, train)
                 idx += 1
@@ -170,6 +202,7 @@ def create_model(cfg: ModelConfig) -> MobileNetV2:
         num_classes=cfg.num_classes,
         width_mult=cfg.width_mult,
         dropout_rate=cfg.dropout_rate,
+        use_pallas=cfg.use_pallas_depthwise,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),
     )

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for tpunet's hot ops.
+
+The MobileNetV2 compute profile on TPU splits into MXU work (1x1
+expansion/projection convs and the stem — XLA tiles these onto the
+systolic array well) and VPU work (the 3x3 depthwise convs — 9
+multiply-adds per output element with no contraction to feed the MXU).
+The depthwise layers are the one place a hand-written kernel can beat
+XLA's generic conv emitter, so that is what lives here.
+"""
+
+from tpunet.ops.depthwise import depthwise_conv3x3, depthwise_conv3x3_reference
+
+__all__ = ["depthwise_conv3x3", "depthwise_conv3x3_reference"]

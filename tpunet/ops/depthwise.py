@@ -1,0 +1,171 @@
+"""Pallas TPU kernel: 3x3 depthwise convolution (NHWC, torch padding=1).
+
+This is the VPU-bound hot op of MobileNetV2 (the reference consumes it
+through cuDNN inside torchvision's ``mobilenet_v2``; here it is a
+first-class kernel). Design:
+
+- Input is pre-padded by one pixel (XLA fuses the pad), so the kernel
+  body is 9 shifted multiply-adds over a VMEM-resident image — pure VPU
+  work with no bounds logic. Channels ride the lane dimension (NHWC).
+- Grid is (batch,); each program owns one image. MobileNetV2's largest
+  depthwise activation (112x112x96) is ~2.5 MB in bfloat16, so the
+  whole image + output fit VMEM comfortably.
+- Stride 2 is expressed as slice + reshape + take (no strided vector
+  slices, which Mosaic handles poorly).
+- Accumulation in float32 regardless of compute dtype; output cast back.
+- ``jax.custom_vjp``: forward runs the Pallas kernel, backward is the
+  transpose of the XLA reference implementation (via ``jax.vjp``), so
+  training gradients are exactly the reference's.
+
+Numerically identical (up to dtype rounding) to
+``depthwise_conv3x3_reference`` — property-tested in interpret mode on
+CPU (tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def depthwise_conv3x3_reference(x: jax.Array, w: jax.Array,
+                                stride: int = 1) -> jax.Array:
+    """XLA reference: x [N,H,W,C], w [3,3,C] -> [N,Ho,Wo,C], padding=1."""
+    return jax.lax.conv_general_dilated(
+        x, w[:, :, None, :],
+        window_strides=(stride, stride),
+        padding=((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=x.shape[-1],
+    )
+
+
+def _tap(x, dy: int, dx: int, ho: int, wo: int, stride: int):
+    """x [Hp, Wp, C] -> the (ho, wo, C) input samples for kernel tap
+    (dy, dx): rows dy, dy+stride, ...; cols dx, dx+stride, ..."""
+    if stride == 1:
+        return x[dy:dy + ho, dx:dx + wo]
+    v = x[dy:dy + stride * ho, dx:dx + stride * wo]
+    c = v.shape[-1]
+    v = v.reshape(ho, stride, stride * wo, c)[:, 0]
+    return v.reshape(ho, wo, stride, c)[:, :, 0]
+
+
+def _kernel(x_ref, w_ref, o_ref, *, ho: int, wo: int, stride: int):
+    x = x_ref[0]                       # (Hp, Wp, C)
+    w = w_ref[:]                       # (3, 3, C)
+    acc = jnp.zeros((ho, wo, x.shape[-1]), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            t = _tap(x, dy, dx, ho, wo, stride).astype(jnp.float32)
+            acc = acc + t * w[dy, dx].astype(jnp.float32)
+    o_ref[0] = acc.astype(o_ref.dtype)
+
+
+def _pallas_forward(x: jax.Array, w: jax.Array, stride: int,
+                    interpret: bool) -> jax.Array:
+    n, h, w_in, c = x.shape
+    ho = (h - 1) // stride + 1
+    wo = (w_in - 1) // stride + 1
+    # Pad so every tap's full slice (stride*ho rows from offset <=2, for
+    # the stride>1 reshape trick) stays in bounds; the extra zero rows
+    # land only in discarded reshape positions.
+    pad_b = stride * ho + 1 - h
+    pad_r = stride * wo + 1 - w_in
+    xp = jnp.pad(x, ((0, 0), (1, pad_b), (1, pad_r), (0, 0)))
+    hp, wp = xp.shape[1], xp.shape[2]
+
+    kern = functools.partial(_kernel, ho=ho, wo=wo, stride=stride)
+    return pl.pallas_call(
+        kern,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, hp, wp, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, c), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, ho, wo, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, ho, wo, c), x.dtype),
+        interpret=interpret,
+    )(xp, w)
+
+
+# ---------------------------------------------------------------------------
+# SPMD partitioning: a pallas_call is opaque to GSPMD, so without help the
+# partitioner would all-gather the batch onto every device. The op is
+# trivially parallel over batch and channels (the kernel grids over N and
+# is elementwise in C), so we register exactly that rule and lower to a
+# per-shard pallas call. H/W stay replicated.
+# ---------------------------------------------------------------------------
+
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _shard_specs(arg_shapes):
+    def spec_of(s):
+        sh = s.sharding
+        return sh.spec if isinstance(sh, NamedSharding) else P()
+    xs = spec_of(arg_shapes[0])
+    xp = list(xs) + [None] * (4 - len(xs))
+    return P(xp[0], None, None, xp[3])
+
+
+def _infer(stride, interpret, mesh, arg_shapes, result_shape):
+    spec = _shard_specs(arg_shapes)
+    return NamedSharding(mesh, spec)
+
+
+def _partition(stride, interpret, mesh, arg_shapes, result_shape):
+    spec = _shard_specs(arg_shapes)
+    arg_shardings = (NamedSharding(mesh, spec),
+                     NamedSharding(mesh, P(None, None, spec[3])))
+    result_sharding = NamedSharding(mesh, spec)
+
+    def lower_fn(x, w):
+        return _pallas_forward(x, w, stride, interpret)
+
+    return mesh, lower_fn, result_sharding, arg_shardings
+
+
+_partitioned = custom_partitioning(_pallas_forward, static_argnums=(2, 3))
+_partitioned.def_partition(
+    partition=_partition,
+    infer_sharding_from_operands=_infer,
+    sharding_rule="n h w c, kh kw c -> n ho wo c",
+    need_replication_factors=("h", "w", "kh", "kw", "ho", "wo"),
+)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def depthwise_conv3x3(x: jax.Array, w: jax.Array, stride: int = 1,
+                      interpret: Optional[bool] = None) -> jax.Array:
+    """3x3 depthwise conv, NHWC, padding=1 (torch semantics).
+
+    ``x`` [N,H,W,C], ``w`` [3,3,C]. Forward runs the Pallas kernel
+    (interpret mode automatically when not on TPU, so it runs anywhere);
+    under SPMD jit it partitions over batch/channels via the registered
+    rule. Gradients are exactly the XLA reference's.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _partitioned(x, w, stride, interpret)
+
+
+def _fwd(x, w, stride, interpret):
+    # With nondiff_argnums, f_fwd takes the primal's full signature;
+    # f_bwd gets the nondiff args first.
+    return depthwise_conv3x3(x, w, stride, interpret), (x, w)
+
+
+def _bwd(stride, interpret, res, g):
+    x, w = res
+    _, vjp = jax.vjp(lambda xx, ww: depthwise_conv3x3_reference(
+        xx, ww, stride), x, w)
+    return vjp(g)
+
+
+depthwise_conv3x3.defvjp(_fwd, _bwd)
