@@ -19,14 +19,19 @@ import os
 import sys
 import time
 
-# Persistent compiled-program cache: TPU compiles in this environment go
-# through a slow remote-compile relay, so cache hits across runs matter.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".jax_cache"))
-
 import jax
 import numpy as np
+
+# Persistent compiled-program cache: TPU compiles in this environment go
+# through a slow remote-compile relay, so cache hits across runs matter.
+# Must be set via jax.config (not env): sitecustomize imports jax before
+# this script runs, so jax has already read the environment.
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 BASELINE_IMG_PER_SEC = 94.7  # 1x V100, BASELINE.md ("north star" x4 target)
 

@@ -5,6 +5,8 @@ reference depthwise conv (the op torchvision's MobileNetV2 runs via
 cuDNN in the reference project) for every shape MobileNetV2 uses.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -81,7 +83,9 @@ def test_gradients_match_reference():
                                rtol=1e-4, atol=1e-4)
 
 
-def test_jit_and_vmap_compose():
+def test_jit_composes():
+    # NOTE: jax.vmap over the op is unsupported (custom_partitioning has
+    # no batching rule); the op is already batched over N.
     x = _rand((4, 28, 28, 8), 8)
     w = _rand((3, 3, 8), 9)
     f = jax.jit(lambda x, w: depthwise_conv3x3(x, w, 1, True))
@@ -91,16 +95,24 @@ def test_jit_and_vmap_compose():
         rtol=1e-5, atol=1e-5)
 
 
-def test_model_flag_same_params_same_logits():
+def test_model_flag_same_params_same_logits(monkeypatch):
     """The pallas and XLA depthwise paths share one parameter tree and
-    produce the same logits (ModelConfig.use_pallas_depthwise)."""
+    produce the same logits (ModelConfig.use_pallas_depthwise).
+
+    Off-TPU the op defaults to the XLA reference, so force the kernel
+    into interpret mode to actually exercise the Pallas path here."""
+    import tpunet.ops as ops
     from tpunet.config import ModelConfig
     from tpunet.models.mobilenetv2 import create_model, init_variables
 
+    orig = ops.depthwise_conv3x3
+    monkeypatch.setattr(
+        ops, "depthwise_conv3x3",
+        lambda x, w, stride=1, interpret=None: orig(x, w, stride, True))
+
     cfg = ModelConfig(dtype="float32", width_mult=0.5)
     ref = create_model(cfg)
-    pal = create_model(
-        __import__("dataclasses").replace(cfg, use_pallas_depthwise=True))
+    pal = create_model(dataclasses.replace(cfg, use_pallas_depthwise=True))
     variables = init_variables(ref, jax.random.PRNGKey(0), image_size=32)
     assert (jax.tree_util.tree_structure(variables) ==
             jax.tree_util.tree_structure(

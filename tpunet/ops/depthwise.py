@@ -145,13 +145,17 @@ def depthwise_conv3x3(x: jax.Array, w: jax.Array, stride: int = 1,
                       interpret: Optional[bool] = None) -> jax.Array:
     """3x3 depthwise conv, NHWC, padding=1 (torch semantics).
 
-    ``x`` [N,H,W,C], ``w`` [3,3,C]. Forward runs the Pallas kernel
-    (interpret mode automatically when not on TPU, so it runs anywhere);
-    under SPMD jit it partitions over batch/channels via the registered
-    rule. Gradients are exactly the XLA reference's.
+    ``x`` [N,H,W,C], ``w`` [3,3,C]. Forward runs the Pallas kernel on
+    TPU; off-TPU the default is the XLA reference (the Pallas
+    interpreter is far too slow for a hot path — pass ``interpret=True``
+    explicitly to exercise the kernel in tests). Under SPMD jit it
+    partitions over batch/channels via the registered rule. Gradients
+    are exactly the XLA reference's.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        if jax.default_backend() != "tpu":
+            return depthwise_conv3x3_reference(x, w, stride)
+        interpret = False
     return _partitioned(x, w, stride, interpret)
 
 
