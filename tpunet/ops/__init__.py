@@ -1,13 +1,26 @@
-"""Pallas TPU kernels for tpunet's hot ops.
+"""TPU kernels and attention ops for tpunet's hot paths.
 
-The MobileNetV2 compute profile on TPU splits into MXU work (1x1
-expansion/projection convs and the stem — XLA tiles these onto the
-systolic array well) and VPU work (the 3x3 depthwise convs — 9
-multiply-adds per output element with no contraction to feed the MXU).
-The depthwise layers are the one place a hand-written kernel can beat
-XLA's generic conv emitter, so that is what lives here.
+Two families live here:
+
+- ``depthwise``: Pallas TPU kernel for the 3x3 depthwise convolution —
+  the VPU-bound hot op of MobileNetV2 (9 multiply-adds per output
+  element with no contraction to feed the MXU; the one place a
+  hand-written kernel beats XLA's generic conv emitter).
+- ``attention``: dense / blockwise / ring attention. Ring attention is
+  the sequence-parallel primitive (K/V shards rotate over a mesh axis
+  via ppermute with online-softmax accumulation) backing long-context
+  support in the attention-based model families.
 """
 
+from tpunet.ops.attention import (blockwise_attention, dense_attention,
+                                  ring_attention, ring_self_attention)
 from tpunet.ops.depthwise import depthwise_conv3x3, depthwise_conv3x3_reference
 
-__all__ = ["depthwise_conv3x3", "depthwise_conv3x3_reference"]
+__all__ = [
+    "blockwise_attention",
+    "dense_attention",
+    "depthwise_conv3x3",
+    "depthwise_conv3x3_reference",
+    "ring_attention",
+    "ring_self_attention",
+]

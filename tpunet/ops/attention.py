@@ -1,0 +1,224 @@
+"""Attention ops: dense, blockwise, and ring (sequence-parallel) attention.
+
+The reference workload is a CNN with no attention anywhere (SURVEY.md
+section 2b), but tpunet treats long-context support as first-class: these
+ops are the sequence/context-parallel layer that the attention-based
+model families (tpunet/models/) build on.
+
+Design (TPU-first):
+
+- All variants share one *online-softmax* block update (the math of
+  FlashAttention / Rabe-Staats): running max ``m``, normalizer ``l`` and
+  un-normalized accumulator ``acc`` are carried across key/value blocks,
+  so the full [Tq, Tk] score matrix never materializes. Accumulation is
+  float32 regardless of compute dtype.
+- ``blockwise_attention`` scans the *local* K/V in chunks — bounded
+  memory for long sequences on one chip.
+- ``ring_attention`` is the sequence-parallel form (Liu et al., "Ring
+  Attention with Blockwise Transformers"): Q stays put, K/V shards
+  rotate around the mesh axis via ``lax.ppermute`` (one ICI hop per
+  step), each arrival folded in with the same online-softmax update.
+  It is written against a shard_map axis name; ``ring_self_attention``
+  wraps it in ``jax.shard_map`` over a mesh.
+- Layout is [batch, seq, heads, head_dim] (BTHD) throughout.
+- Causal masking uses *global* positions reconstructed from the axis
+  index, so causality is exact under sequence sharding.
+
+Differentiable end-to-end (the ring rotation is a ``lax.scan``; JAX
+reverse-differentiates through the ppermutes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/grads NaN-free
+
+
+# ---------------------------------------------------------------------------
+# Shared online-softmax block update
+# ---------------------------------------------------------------------------
+
+def _block_update(carry: Tuple[jax.Array, jax.Array, jax.Array],
+                  q: jax.Array, k: jax.Array, v: jax.Array,
+                  scale: float,
+                  mask: Optional[jax.Array]) -> Tuple[jax.Array, jax.Array,
+                                                      jax.Array]:
+    """Fold one K/V block into the (m, l, acc) running softmax state.
+
+    q [B,Tq,H,D]; k,v [B,Tk,H,D]; mask [Tq,Tk] bool (True = attend) or
+    None. m,l [B,H,Tq]; acc [B,Tq,H,D]. All state float32.
+    """
+    m, l, acc = carry
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # Rows with nothing to attend to yet keep m at the initial floor;
+    # exp(s - floor) would overflow, so shift defensively.
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None, :, :], p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                    preferred_element_type=jnp.float32)
+    acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l, acc
+
+
+def _init_carry(q: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    b, tq, h, d = q.shape
+    m = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, tq), jnp.float32)
+    acc = jnp.zeros((b, tq, h, d), jnp.float32)
+    return m, l, acc
+
+
+def _finalize(m, l, acc, dtype) -> jax.Array:
+    # l == 0 only for rows masked out of every block; emit zeros there.
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dense reference
+# ---------------------------------------------------------------------------
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False,
+                    scale: Optional[float] = None) -> jax.Array:
+    """Plain softmax attention, float32 accumulation. BTHD layout."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    mask = None
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if mask is not None:
+        # Rows with no valid key (tq > tk top rows) get zeros, matching
+        # the l == 0 convention of the blockwise/ring variants — softmax
+        # alone would attend uniformly, leaking masked values.
+        p = jnp.where(mask.any(-1)[None, None, :, None], p, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise attention (single device, chunked K/V)
+# ---------------------------------------------------------------------------
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        block_size: int = 512,
+                        causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Online-softmax attention over K/V chunks of ``block_size``.
+
+    Memory is O(Tq * block_size) instead of O(Tq * Tk); exact same
+    result as ``dense_attention``.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    tq, tk = q.shape[1], k.shape[1]
+    block_size = min(block_size, tk)
+    if tk % block_size != 0:
+        raise ValueError(f"seq len {tk} not divisible by block {block_size}")
+    n_blocks = tk // block_size
+    kb = k.reshape(k.shape[0], n_blocks, block_size, *k.shape[2:])
+    vb = v.reshape(v.shape[0], n_blocks, block_size, *v.shape[2:])
+    q_pos = jnp.arange(tq)
+
+    def body(carry, xs):
+        j, k_j, v_j = xs
+        mask = None
+        if causal:
+            k_pos = j * block_size + jnp.arange(block_size)
+            mask = q_pos[:, None] + (tk - tq) >= k_pos[None, :]
+        return _block_update(carry, q, k_j, v_j, scale, mask), None
+
+    (m, l, acc), _ = jax.lax.scan(
+        body, _init_carry(q),
+        (jnp.arange(n_blocks), kb.swapaxes(0, 1), vb.swapaxes(0, 1)))
+    return _finalize(m, l, acc, q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence-parallel, shard_map body)
+# ---------------------------------------------------------------------------
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, *,
+                   causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Sequence-parallel attention over shard_map axis ``axis_name``.
+
+    Call inside ``shard_map`` with q/k/v sharded on their seq dim over
+    ``axis_name``. K/V shards rotate around the ring (``lax.ppermute``,
+    one neighbor hop per step — ICI-friendly); each arriving block is
+    folded in with the online-softmax update. Exactly matches
+    ``dense_attention`` on the gathered arrays.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    tq = q.shape[1]
+    tk = k.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_pos = my * tq + jnp.arange(tq)
+
+    def block_mask(step):
+        # k block held at `step` originated on device (my - step) mod n.
+        if not causal:
+            return None
+        k_pos = ((my - step) % n) * tk + jnp.arange(tk)
+        return q_pos[:, None] >= k_pos[None, :]
+
+    def body(carry, step):
+        state, k_cur, v_cur = carry
+        state = _block_update(state, q, k_cur, v_cur, scale,
+                              block_mask(step))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (state, k_nxt, v_nxt), None
+
+    # n-1 update+rotate steps, then a final update with no rotation (the
+    # last ppermute's result would be discarded, but XLA cannot DCE a
+    # collective inside the scan — one wasted ICI hop per layer per step).
+    state, k_last, v_last = _init_carry(q), k, v
+    if n > 1:
+        (state, k_last, v_last), _ = jax.lax.scan(
+            body, (state, k, v), jnp.arange(n - 1))
+    m, l, acc = _block_update(state, q, k_last, v_last, scale,
+                              block_mask(n - 1))
+    return _finalize(m, l, acc, q.dtype)
+
+
+def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        mesh: Mesh, *,
+                        seq_axis: str = "seq",
+                        batch_axis: str = "data",
+                        causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """shard_map wrapper: global BTHD arrays in, ring attention inside.
+
+    Batch dim sharded over ``batch_axis``, seq dim over ``seq_axis``;
+    head/depth dims replicated (tensor-parallel head sharding composes
+    at the caller by mapping heads over 'model' before this op).
+    """
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
