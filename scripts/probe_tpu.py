@@ -17,7 +17,7 @@ def main():
 
     from tpunet.config import DataConfig, ModelConfig, OptimConfig
     from tpunet.data.augment import make_eval_preprocess, make_train_augment
-    from tpunet.models.mobilenetv2 import create_model, init_variables
+    from tpunet.models import create_model, init_variables
 
     x8 = np.random.default_rng(0).integers(
         0, 256, size=(batch, 32, 32, 3), dtype=np.uint8)

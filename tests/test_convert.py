@@ -15,7 +15,7 @@ torch = pytest.importorskip("torch")
 
 from tpunet.config import ModelConfig
 from tpunet.models.convert import convert_torch_state_dict, merge_pretrained
-from tpunet.models.mobilenetv2 import create_model, init_variables
+from tpunet.models import create_model, init_variables
 
 from torch_ref_mobilenetv2 import TorchMobileNetV2
 

@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import pytest
 
 from tpunet.config import ModelConfig
-from tpunet.models.mobilenetv2 import create_model, init_variables, num_params
+from tpunet.models import create_model, init_variables, num_params
 
 
 @pytest.fixture(scope="module")

@@ -103,7 +103,7 @@ def test_model_flag_same_params_same_logits(monkeypatch):
     into interpret mode to actually exercise the Pallas path here."""
     import tpunet.ops as ops
     from tpunet.config import ModelConfig
-    from tpunet.models.mobilenetv2 import create_model, init_variables
+    from tpunet.models import create_model, init_variables
 
     orig = ops.depthwise_conv3x3
     monkeypatch.setattr(

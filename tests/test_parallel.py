@@ -13,13 +13,18 @@ from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
 def test_default_mesh_uses_all_devices():
     mesh = make_mesh(MeshConfig())
     assert mesh.devices.size == 8
-    assert mesh.axis_names == ("data", "model")
+    assert mesh.axis_names == ("data", "seq", "model")
     assert mesh.shape["data"] == 8 and mesh.shape["model"] == 1
+    assert mesh.shape["seq"] == 1
 
 
 def test_explicit_mesh_shape():
     mesh = make_mesh(MeshConfig(data=4, model=2))
     assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    mesh = make_mesh(MeshConfig(data=2, seq=2, model=2))
+    assert (mesh.shape["data"], mesh.shape["seq"], mesh.shape["model"]) \
+        == (2, 2, 2)
 
 
 def test_mesh_subset_of_devices():

@@ -1,0 +1,117 @@
+"""ViT model family: shapes, registry dispatch, attention impl parity,
+and end-to-end training through the model-agnostic Trainer."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, OptimConfig, TrainConfig)
+from tpunet.models import create_model, init_variables, num_params
+
+VIT_CFG = ModelConfig(name="vit", vit_patch=4, vit_hidden=64, vit_depth=2,
+                      vit_heads=4, dropout_rate=0.0, dtype="float32")
+
+
+def _vars(cfg=VIT_CFG, size=32):
+    model = create_model(cfg)
+    return model, init_variables(model, jax.random.PRNGKey(0),
+                                 image_size=size)
+
+
+def test_forward_shapes_and_no_batch_stats():
+    model, variables = _vars()
+    assert "batch_stats" not in variables
+    x = jnp.zeros((3, 32, 32, 3), jnp.float32)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (3, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_registry_dispatch_and_presets():
+    tiny = create_model(ModelConfig(name="vit_tiny"))
+    assert (tiny.patch_size, tiny.hidden, tiny.depth, tiny.heads) == \
+        (16, 192, 12, 3)
+    with pytest.raises(ValueError):
+        create_model(ModelConfig(name="nope"))
+
+
+def test_param_count_scales_with_depth():
+    _, v2 = _vars(dataclasses.replace(VIT_CFG, vit_depth=2))
+    _, v4 = _vars(dataclasses.replace(VIT_CFG, vit_depth=4))
+    assert num_params(v4["params"]) > num_params(v2["params"])
+
+
+def test_indivisible_patch_raises():
+    model, variables = _vars()
+    with pytest.raises(ValueError):
+        model.apply(variables, jnp.zeros((1, 30, 30, 3)), train=False)
+
+
+def test_blockwise_attention_matches_dense():
+    dense_model, variables = _vars()
+    bw_cfg = dataclasses.replace(VIT_CFG, attention="blockwise",
+                                 attention_block=16)
+    bw_model = create_model(bw_cfg)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 32, 32, 3)),
+                    jnp.float32)
+    a = dense_model.apply(variables, x, train=False)
+    b = bw_model.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+
+
+def _train_cfg(**model_kw):
+    model = dataclasses.replace(VIT_CFG, **model_kw)
+    return TrainConfig(
+        epochs=2,
+        data=DataConfig(dataset="synthetic", image_size=32, batch_size=32,
+                        synthetic_train_size=128, synthetic_test_size=32),
+        model=model,
+        optim=OptimConfig(learning_rate=1e-3),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+
+
+def test_vit_trains_through_trainer():
+    from tpunet.train.loop import Trainer
+    trainer = Trainer(_train_cfg())
+    try:
+        m0 = trainer.train_one_epoch(1)
+        m1 = trainer.train_one_epoch(2)
+        ev = trainer.evaluate()
+    finally:
+        trainer.close()
+    assert np.isfinite(m0["loss"]) and np.isfinite(m1["loss"])
+    assert m1["loss"] < m0["loss"] + 0.5  # training is not diverging
+    assert ev["count"] == 32
+
+
+def test_vit_ring_attention_through_trainer_matches_dense():
+    """Full jitted train step with ring attention over a ('data','seq')
+    mesh == the dense-attention step on the same data (task: sequence
+    parallelism is exact, not approximate)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpunet.train.loop import Trainer
+
+    dense_tr = Trainer(_train_cfg())
+    try:
+        dense_m = dense_tr.train_one_epoch(1)
+    finally:
+        dense_tr.close()
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "seq"))
+    ring_tr = Trainer(_train_cfg(attention="ring"), mesh=mesh)
+    try:
+        ring_m = ring_tr.train_one_epoch(1)
+    finally:
+        ring_tr.close()
+    assert abs(dense_m["loss"] - ring_m["loss"]) < 1e-4
+    assert abs(dense_m["accuracy"] - ring_m["accuracy"]) < 1e-6

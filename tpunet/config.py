@@ -73,12 +73,24 @@ class ModelConfig:
     """Model config (reference model at :137-139: torchvision MobileNetV2
     with the classifier head swapped to 10 classes)."""
 
-    name: str = "mobilenet_v2"
+    name: str = "mobilenet_v2"        # mobilenet_v2 | vit | vit_{tiny,small,base}
     num_classes: int = 10
     width_mult: float = 1.0
     dropout_rate: float = 0.2         # torchvision MobileNetV2 default
     dtype: str = "bfloat16"           # MXU-friendly compute dtype
     param_dtype: str = "float32"
+    # ViT family fields (tpunet/models/vit.py); used when name == "vit"
+    # (the vit_tiny/small/base presets fix patch/hidden/depth/heads).
+    vit_patch: int = 16
+    vit_hidden: int = 192
+    vit_depth: int = 6
+    vit_heads: int = 3
+    vit_mlp_ratio: float = 4.0
+    # Core attention implementation for attention models:
+    # dense | blockwise (chunked K/V, bounded memory) | ring
+    # (sequence-parallel over the mesh 'seq' axis).
+    attention: str = "dense"
+    attention_block: int = 512        # K/V chunk for attention="blockwise"
     # Optional path to a torch state_dict (.pth) with ImageNet-pretrained
     # weights to convert (transfer learning is load-bearing for the ~96%
     # accuracy target — reference README.md:24-26).
@@ -108,17 +120,21 @@ class OptimConfig:
 @dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh config. The reference's only strategy is data parallelism
-    (DDP at :142-145); we build a 2-D ('data', 'model') mesh so the design
-    leaves a model axis open for tensor-parallel sharding (SURVEY.md 2b).
+    (DDP at :142-145); we build a 3-D ('data', 'seq', 'model') mesh so
+    sequence parallelism (ring attention over 'seq') and tensor-parallel
+    sharding (over 'model') layer on without restructuring (SURVEY.md 2b).
     """
 
     data: int = -1                    # -1 -> all remaining devices
-    model: int = 1
+    seq: int = 1                      # sequence/context-parallel axis
+    model: int = 1                    # tensor-parallel axis
 
-    def shape(self, n_devices: int) -> Tuple[int, int]:
+    def shape(self, n_devices: int) -> Tuple[int, int, int]:
+        seq = max(1, self.seq)
         model = max(1, self.model)
-        data = self.data if self.data > 0 else max(1, n_devices // model)
-        return (data, model)
+        data = (self.data if self.data > 0
+                else max(1, n_devices // (seq * model)))
+        return (data, seq, model)
 
 
 @dataclass(frozen=True)
@@ -187,13 +203,29 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--dataset", default=None, choices=["cifar10", "synthetic"])
     p.add_argument("--pretrained", default=None,
                    help="path to a torch MobileNetV2 state_dict to convert")
+    p.add_argument("--model", default=None,
+                   choices=["mobilenet_v2", "vit", "vit_tiny", "vit_small",
+                            "vit_base"])
+    p.add_argument("--attention", default=None,
+                   choices=["dense", "blockwise", "ring"],
+                   help="core attention impl for ViT models; 'ring' is "
+                        "sequence-parallel over the mesh 'seq' axis")
+    p.add_argument("--attention-block", type=int, default=None,
+                   help="K/V chunk size for --attention blockwise")
+    p.add_argument("--vit-patch", type=int, default=None)
+    p.add_argument("--vit-hidden", type=int, default=None)
+    p.add_argument("--vit-depth", type=int, default=None)
+    p.add_argument("--vit-heads", type=int, default=None)
     p.add_argument("--width-mult", type=float, default=None)
     p.add_argument("--synthetic-size", type=int, default=None,
                    help="train-set size when --dataset synthetic")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--mesh-data", type=int, default=None)
-    p.add_argument("--mesh-model", type=int, default=None)
+    p.add_argument("--mesh-seq", type=int, default=None,
+                   help="sequence-parallel axis size (ring attention)")
+    p.add_argument("--mesh-model", type=int, default=None,
+                   help="tensor-parallel axis size")
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--no-native-loader", action="store_true",
@@ -223,6 +255,16 @@ def config_from_args(argv=None) -> TrainConfig:
             synthetic_test_size=max(1, args.synthetic_size // 4))
     if args.pretrained is not None:
         model = dataclasses.replace(model, pretrained_path=args.pretrained)
+    if args.model is not None:
+        model = dataclasses.replace(model, name=args.model)
+    if args.attention is not None:
+        model = dataclasses.replace(model, attention=args.attention)
+    if args.attention_block is not None:
+        model = dataclasses.replace(model, attention_block=args.attention_block)
+    for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads"):
+        val = getattr(args, name)
+        if val is not None:
+            model = dataclasses.replace(model, **{name: val})
     if args.width_mult is not None:
         model = dataclasses.replace(model, width_mult=args.width_mult)
     if args.pallas_depthwise:
@@ -233,6 +275,8 @@ def config_from_args(argv=None) -> TrainConfig:
         optim = dataclasses.replace(optim, learning_rate=args.lr)
     if args.mesh_data is not None:
         mesh = dataclasses.replace(mesh, data=args.mesh_data)
+    if args.mesh_seq is not None:
+        mesh = dataclasses.replace(mesh, seq=args.mesh_seq)
     if args.mesh_model is not None:
         mesh = dataclasses.replace(mesh, model=args.mesh_model)
     if args.checkpoint_dir is not None:

@@ -25,7 +25,7 @@ import numpy as np
 from tpunet.ckpt import Checkpointer
 from tpunet.config import (CIFAR10_CLASSES, CheckpointConfig, DataConfig,
                            ModelConfig)
-from tpunet.models.mobilenetv2 import create_model, init_variables
+from tpunet.models import create_model, init_variables
 
 
 @dataclasses.dataclass
@@ -46,6 +46,11 @@ class Predictor:
                  checkpoint_dir: Optional[str] = None,
                  class_names: Sequence[str] = CIFAR10_CLASSES):
         self.model_cfg = model_cfg or ModelConfig()
+        if self.model_cfg.attention == "ring":
+            # Serving is single-chip; ring attention needs a seq mesh but
+            # computes the same function as dense — swap it out.
+            self.model_cfg = dataclasses.replace(self.model_cfg,
+                                                 attention="dense")
         self.data_cfg = data_cfg or DataConfig()
         self.class_names = tuple(class_names)
         self.model = create_model(self.model_cfg)
@@ -56,13 +61,13 @@ class Predictor:
                 ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
                 best = ckpt.restore_best({
                     "params": variables["params"],
-                    "batch_stats": variables["batch_stats"]})
+                    "batch_stats": variables.get("batch_stats", {})})
                 if best is None:
                     raise FileNotFoundError(
                         f"no best checkpoint under {checkpoint_dir!r}")
                 variables = best
         self.variables = {"params": variables["params"],
-                          "batch_stats": variables["batch_stats"]}
+                          "batch_stats": variables.get("batch_stats", {})}
         size = self.data_cfg.image_size
         mean = jnp.asarray(self.data_cfg.mean)
         std = jnp.asarray(self.data_cfg.std)

@@ -1,2 +1,44 @@
-from tpunet.models.mobilenetv2 import MobileNetV2, create_model  # noqa: F401
+"""Model registry.
+
+``create_model(cfg, mesh=None)`` dispatches on ``ModelConfig.name``:
+the reference's one model (MobileNetV2, cifar10_mpi_mobilenet_224.py:
+137-139) plus tpunet's attention-based ViT family. ``init_variables``
+is model-agnostic — some models carry BatchNorm statistics (MobileNetV2)
+and some do not (ViT); callers use ``variables.get("batch_stats", {})``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpunet.config import ModelConfig
+from tpunet.models import mobilenetv2, vit
 from tpunet.models.convert import convert_torch_state_dict, load_pretrained  # noqa: F401
+from tpunet.models.mobilenetv2 import MobileNetV2  # noqa: F401
+from tpunet.models.vit import ViT, VIT_PRESETS  # noqa: F401
+
+
+def create_model(cfg: ModelConfig, mesh=None):
+    """Build the configured model. ``mesh`` is needed only by models
+    whose attention runs sequence-parallel (attention='ring')."""
+    if cfg.name == "mobilenet_v2":
+        return mobilenetv2.create_model(cfg)
+    if cfg.name == "vit" or cfg.name in VIT_PRESETS:
+        return vit.create_model(cfg, mesh=mesh)
+    raise ValueError(f"unknown model {cfg.name!r}")
+
+
+def init_variables(model, rng: jax.Array, image_size: int = 224,
+                   batch_size: int = 1) -> dict:
+    """Initialize model variables with a dummy NHWC batch.
+
+    ``batch_size`` matters only for models whose attention runs under
+    shard_map (ring): the init batch must divide the mesh's batch axes.
+    """
+    dummy = jnp.zeros((batch_size, image_size, image_size, 3), jnp.float32)
+    return model.init({"params": rng}, dummy, train=False)
+
+
+def num_params(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))

@@ -208,12 +208,3 @@ def create_model(cfg: ModelConfig) -> MobileNetV2:
     )
 
 
-def init_variables(model: MobileNetV2, rng: jax.Array,
-                   image_size: int = 224) -> dict:
-    """Initialize {'params', 'batch_stats'} with a dummy NHWC batch."""
-    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
-    return model.init({"params": rng}, dummy, train=False)
-
-
-def num_params(params) -> int:
-    return sum(p.size for p in jax.tree_util.tree_leaves(params))

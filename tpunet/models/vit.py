@@ -1,0 +1,211 @@
+"""Vision Transformer in Flax — tpunet's attention-based model family.
+
+The reference has exactly one model (torchvision MobileNetV2,
+cifar10_mpi_mobilenet_224.py:137-139). tpunet adds a ViT family because
+a TPU framework's parallelism surface is defined by attention: sequence/
+context parallelism (ring attention over a 'seq' mesh axis), tensor
+parallelism (heads/MLP over the 'model' axis) and expert parallelism all
+need a transformer to exercise them end-to-end on the same CIFAR-10
+workload, trainer, checkpointing and serving stack as the CNN.
+
+TPU-first choices:
+
+- Pre-LN encoder, mean-pooled tokens (no CLS token: the sequence stays
+  exactly ``(image/patch)**2`` long, so it divides evenly over a
+  sequence-parallel mesh axis).
+- bfloat16 compute / float32 params; logits float32.
+- The attention implementation is injected (``attn_fn``): dense or
+  blockwise for a single chip, ``ring_self_attention`` over the 'seq'
+  mesh axis for sequence parallelism (tpunet/ops/attention.py). The
+  module itself stays mesh-agnostic.
+- QKV / output / MLP projections are single fused Dense ops — large
+  matmuls for the MXU; tensor-parallel sharding of their parameters is
+  applied from outside via path rules (tpunet/parallel/tp.py), not
+  baked into the module.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpunet.config import ModelConfig
+from tpunet.ops import blockwise_attention, dense_attention
+
+AttnFn = Callable[..., jax.Array]  # (q, k, v) BTHD -> BTHD
+
+
+class Attention(nn.Module):
+    """Multi-head self-attention with an injected core attention op."""
+
+    heads: int
+    attn_fn: AttnFn = dense_attention
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, t, c = x.shape
+        if c % self.heads:
+            raise ValueError(
+                f"hidden dim {c} not divisible by {self.heads} heads")
+        head_dim = c // self.heads
+        qkv = nn.Dense(3 * c, use_bias=True, dtype=self.dtype,
+                       param_dtype=self.param_dtype, name="qkv")(x)
+        qkv = qkv.reshape(b, t, 3, self.heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        y = self.attn_fn(q, k, v)
+        y = y.reshape(b, t, c)
+        y = nn.Dense(c, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="out")(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return y
+
+
+class MlpBlock(nn.Module):
+    """Transformer MLP: Dense -> GELU -> Dense."""
+
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = x.shape[-1]
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="fc1")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(c, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="fc2")(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return y
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN block: x + Attn(LN(x)); x + Mlp(LN(x))."""
+
+    heads: int
+    mlp_dim: int
+    attn_fn: AttnFn = dense_attention
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln1")(x)
+        x = x + Attention(self.heads, attn_fn=self.attn_fn,
+                          dropout_rate=self.dropout_rate, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="attn")(y, train)
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln2")(x)
+        x = x + MlpBlock(self.mlp_dim, dropout_rate=self.dropout_rate,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="mlp")(y, train)
+        return x
+
+
+class ViT(nn.Module):
+    """ViT backbone + linear head; same call signature as MobileNetV2
+    (NHWC normalized images in, float32 logits out) so the trainer,
+    checkpointing and serving stack are model-agnostic."""
+
+    num_classes: int = 10
+    patch_size: int = 16
+    hidden: int = 192
+    depth: int = 6
+    heads: int = 3
+    mlp_ratio: float = 4.0
+    dropout_rate: float = 0.0
+    attn_fn: AttnFn = dense_attention
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = self.patch_size
+        if x.shape[1] % p or x.shape[2] % p:
+            raise ValueError(
+                f"image {x.shape[1]}x{x.shape[2]} not divisible by "
+                f"patch {p}")
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.hidden, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="patch_embed")(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
+                         (1, h * w, c), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = EncoderBlock(self.heads, int(self.hidden * self.mlp_ratio),
+                             attn_fn=self.attn_fn,
+                             dropout_rate=self.dropout_rate,
+                             dtype=self.dtype, param_dtype=self.param_dtype,
+                             name=f"block{i:02d}")(x, train)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln")(x)
+        x = jnp.mean(x, axis=1)  # mean pool over tokens
+        x = nn.Dense(self.num_classes,
+                     kernel_init=nn.initializers.zeros_init(),
+                     dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+# Name -> (patch, hidden, depth, heads). "vit" uses the ModelConfig's
+# vit_* fields directly.
+VIT_PRESETS = {
+    "vit_tiny": (16, 192, 12, 3),
+    "vit_small": (16, 384, 12, 6),
+    "vit_base": (16, 768, 12, 12),
+}
+
+
+def make_attn_fn(cfg: ModelConfig, mesh=None) -> AttnFn:
+    """Resolve the configured attention implementation.
+
+    'ring' needs the mesh (sequence-parallel shard_map over its 'seq'
+    axis); 'dense'/'blockwise' are mesh-free.
+    """
+    import functools
+    if cfg.attention == "dense":
+        return dense_attention
+    if cfg.attention == "blockwise":
+        return functools.partial(blockwise_attention,
+                                 block_size=cfg.attention_block)
+    if cfg.attention == "ring":
+        if mesh is None:
+            raise ValueError("attention='ring' requires a mesh")
+        from tpunet.ops import ring_self_attention
+        return functools.partial(ring_self_attention, mesh=mesh)
+    raise ValueError(f"unknown attention {cfg.attention!r}")
+
+
+def create_model(cfg: ModelConfig, mesh=None) -> ViT:
+    if cfg.name in VIT_PRESETS:
+        patch, hidden, depth, heads = VIT_PRESETS[cfg.name]
+    elif cfg.name == "vit":
+        patch, hidden, depth, heads = (cfg.vit_patch, cfg.vit_hidden,
+                                       cfg.vit_depth, cfg.vit_heads)
+    else:
+        raise ValueError(f"unknown ViT model {cfg.name!r}")
+    return ViT(
+        num_classes=cfg.num_classes,
+        patch_size=patch,
+        hidden=hidden,
+        depth=depth,
+        heads=heads,
+        mlp_ratio=cfg.vit_mlp_ratio,
+        dropout_rate=cfg.dropout_rate,
+        attn_fn=make_attn_fn(cfg, mesh),
+        dtype=jnp.dtype(cfg.dtype),
+        param_dtype=jnp.dtype(cfg.param_dtype),
+    )

@@ -2,3 +2,4 @@ from tpunet.parallel.mesh import (  # noqa: F401
     make_mesh, batch_sharding, replicated_sharding, shard_host_batch)
 from tpunet.parallel.dist import (  # noqa: F401
     initialize_distributed, process_index, process_count, sync_hosts)
+from tpunet.parallel.tp import rules_for, tree_shardings  # noqa: F401

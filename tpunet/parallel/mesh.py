@@ -9,9 +9,11 @@ batch sharded on the ``data`` axis and parameters replicated — XLA then
 inserts the gradient all-reduce (over ICI on a TPU slice) itself, fused
 into the step program.
 
-The mesh is 2-D ``('data', 'model')`` so tensor-parallel param sharding
-can be layered on without restructuring (the reference is DP-only;
-SURVEY.md section 2b).
+The mesh is 3-D ``('data', 'seq', 'model')``: the reference is DP-only
+(SURVEY.md section 2b), and the extra axes carry sequence parallelism
+(ring attention rotates K/V over 'seq' — tpunet/ops/attention.py) and
+tensor-parallel param sharding (tpunet/parallel/tp.py) without
+restructuring. Unused axes have size 1 and cost nothing.
 """
 
 from __future__ import annotations
@@ -30,16 +32,17 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     cfg = cfg or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
-    data, model = cfg.shape(len(devices))
-    n = data * model
+    data, seq, model = cfg.shape(len(devices))
+    n = data * seq * model
     if n > len(devices):
-        raise ValueError(
-            f"mesh {data}x{model} needs {n} devices, have {len(devices)}")
+        raise ValueError(f"mesh {data}x{seq}x{model} needs {n} devices, "
+                         f"have {len(devices)}")
     if n == len(devices):
-        dmesh = mesh_utils.create_device_mesh((data, model), devices=devices)
+        dmesh = mesh_utils.create_device_mesh((data, seq, model),
+                                              devices=devices)
     else:
-        dmesh = np.asarray(devices[:n]).reshape(data, model)
-    return Mesh(dmesh, ("data", "model"))
+        dmesh = np.asarray(devices[:n]).reshape(data, seq, model)
+    return Mesh(dmesh, ("data", "seq", "model"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,99 @@
+"""Tensor parallelism: path-rule parameter partitioning over 'model'.
+
+The reference replicates every parameter on every rank (README.md:77
+"Model parameters remain consistent across all GPUs"); tpunet adds
+tensor parallelism the XLA way: parameters are *sharded* over the mesh
+'model' axis according to path rules, jit is given the resulting
+shardings, and GSPMD inserts the all-gathers/reduce-scatters — the
+semantics of the program are unchanged (same math, distributed layout),
+so TP composes with data and sequence parallelism without touching the
+model code.
+
+Rules are (regex, PartitionSpec) pairs matched against 'a/b/c' joined
+tree paths. Because optimizer moments (Adam mu/nu) mirror the param
+tree, the same rules match inside ``opt_state`` too — sharding the
+optimizer states alongside their parameters (what ZeRO does with
+hand-rolled bookkeeping, here for free).
+
+The ViT rules implement Megatron-style block sharding: qkv and mlp/fc1
+are column-parallel (output features over 'model'), attn/out and
+mlp/fc2 are row-parallel (input features over 'model') — one reduce
+per block pair, inserted by the compiler.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpunet.config import ModelConfig
+
+Rules = Sequence[Tuple[str, P]]
+
+# Megatron-style ViT sharding (tpunet/models/vit.py module names).
+VIT_TP_RULES: Rules = (
+    (r"attn/qkv/kernel$", P(None, "model")),      # column parallel
+    (r"attn/qkv/bias$", P("model")),
+    (r"attn/out/kernel$", P("model", None)),      # row parallel
+    (r"mlp/fc1/kernel$", P(None, "model")),       # column parallel
+    (r"mlp/fc1/bias$", P("model")),
+    (r"mlp/fc2/kernel$", P("model", None)),       # row parallel
+)
+
+
+def rules_for(cfg: ModelConfig) -> Rules:
+    """TP rules for the configured model. MobileNetV2 stays replicated —
+    at 2.2M params a CNN gains nothing from weight sharding (the
+    reference's replicated layout is already right for it)."""
+    if cfg.name == "vit" or cfg.name.startswith("vit_"):
+        return VIT_TP_RULES
+    return ()
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+    return "/".join(parts)
+
+
+def _spec_for(path_s: str, leaf, mesh: Mesh, rules) -> P:
+    for rx, spec in rules:
+        if rx.search(path_s) is None:
+            continue
+        if len(spec) > getattr(leaf, "ndim", 0):
+            break  # rule doesn't fit this leaf; replicate
+        ok = True
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            # Replicate instead of crashing when the mesh lacks the rule's
+            # axis (custom meshes) or the dim is indivisible.
+            if (axis not in mesh.shape
+                    or leaf.shape[dim] % mesh.shape[axis] != 0):
+                ok = False
+                break
+        if ok:
+            return spec
+        break
+    return P()
+
+
+def tree_shardings(tree, mesh: Mesh, rules: Rules):
+    """NamedSharding tree for ``tree``: rule-matched leaves are sharded,
+    everything else replicated. Works on any pytree whose paths embed
+    param names — TrainState included, so Adam moments inside opt_state
+    pick up their parameter's spec automatically."""
+    compiled = [(re.compile(rx), spec) for rx, spec in rules]
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: NamedSharding(mesh, _spec_for(_path_str(p), x, mesh,
+                                                   compiled)),
+        tree)

@@ -23,6 +23,7 @@ from tpunet.data import (eval_batches, get_dataset, steps_per_epoch,
                          train_batches)
 from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
                              shard_host_batch)
+from tpunet.parallel.tp import rules_for, tree_shardings
 from tpunet.train import metrics as M
 from tpunet.train.state import create_train_state
 from tpunet.train.steps import make_eval_step, make_train_step
@@ -46,18 +47,28 @@ class Trainer:
         state = create_train_state(
             cfg.model, cfg.optim, root_key(cfg.seed),
             image_size=cfg.data.image_size,
-            steps_per_epoch=self.spe, epochs=cfg.epochs)
+            steps_per_epoch=self.spe, epochs=cfg.epochs, mesh=self.mesh)
         repl = replicated_sharding(self.mesh)
         bsh = batch_sharding(self.mesh)
-        self.state = jax.device_put(state, repl)
+        # Tensor parallelism: params (and, via mirrored tree paths, their
+        # Adam moments) matching the model's TP path rules are sharded
+        # over the 'model' mesh axis; everything else is replicated, which
+        # is exactly the reference's DDP layout (README:77).
+        state_sh = tree_shardings(state, self.mesh, rules_for(cfg.model))
+        self.state = jax.device_put(state, state_sh)
 
+        # out_shardings pinned: without it XLA may propagate shard_map
+        # internals (e.g. a 'seq'-sharded pos-embed gradient) onto the
+        # returned state, which would then mismatch in_shardings on the
+        # next call.
         self.train_step = jax.jit(
             make_train_step(cfg.data, cfg.optim),
-            in_shardings=(repl, bsh, bsh, repl),
+            in_shardings=(state_sh, bsh, bsh, repl),
+            out_shardings=(state_sh, repl),
             donate_argnums=0)
         self.eval_step = jax.jit(
             make_eval_step(cfg.data),
-            in_shardings=(repl, bsh, bsh, bsh))
+            in_shardings=(state_sh, bsh, bsh, bsh))
 
         self._prefetcher = None
         if cfg.data.native_loader:
@@ -146,7 +157,7 @@ class Trainer:
         cfg = self.cfg
         log0(f"Train samples: {len(self.train_x)}")
         log0(f"Test samples: {len(self.test_x)}")
-        from tpunet.models.mobilenetv2 import num_params
+        from tpunet.models import num_params
         log0(f"Total parameters: {num_params(self.state.params)}")
         log0("Host loader: " + ("native C++ prefetcher"
                                 if self._prefetcher is not None else "numpy"))

@@ -17,8 +17,8 @@ import optax
 from flax.training import train_state
 
 from tpunet.config import ModelConfig, OptimConfig
+from tpunet.models import create_model, init_variables
 from tpunet.models.convert import load_pretrained
-from tpunet.models.mobilenetv2 import create_model, init_variables
 
 
 class TrainState(train_state.TrainState):
@@ -53,18 +53,34 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int,
 
 def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
                        rng: jax.Array, *, image_size: int,
-                       steps_per_epoch: int, epochs: int) -> TrainState:
+                       steps_per_epoch: int, epochs: int,
+                       mesh=None) -> TrainState:
     """Build model variables (optionally overlaying converted pretrained
-    torch weights, reference :137-139) and the optimizer state."""
-    model = create_model(model_cfg)
-    variables = init_variables(model, rng, image_size=image_size)
+    torch weights, reference :137-139) and the optimizer state.
+
+    ``mesh`` is forwarded to the model registry for models whose
+    attention is sequence-parallel; ``batch_stats`` is empty for models
+    without BatchNorm (the ViT family).
+    """
+    model = create_model(model_cfg, mesh=mesh)
+    # Only ring attention runs shard_map at init, and only the batch dim's
+    # 'data' axis constrains it; everything else initializes with batch 1.
+    init_batch = (mesh.shape["data"]
+                  if mesh is not None and model_cfg.attention == "ring"
+                  else 1)
+    variables = init_variables(model, rng, image_size=image_size,
+                               batch_size=init_batch)
     if model_cfg.pretrained_path:
+        if model_cfg.name != "mobilenet_v2":
+            raise ValueError(
+                "pretrained_path converts torchvision MobileNetV2 "
+                f"state_dicts only; model is {model_cfg.name!r}")
         variables = load_pretrained(model_cfg.pretrained_path, variables,
                                     num_classes=model_cfg.num_classes)
     tx = make_optimizer(optim_cfg, steps_per_epoch, epochs)
     return TrainState.create(
         apply_fn=model.apply,
         params=variables["params"],
-        batch_stats=variables["batch_stats"],
+        batch_stats=variables.get("batch_stats", {}),
         tx=tx,
     )

@@ -39,6 +39,8 @@ def make_train_step(data_cfg: DataConfig,
         images = augment(aug_rng, images_u8)
 
         def loss_fn(params):
+            # mutable=["batch_stats"] is harmless for models without
+            # BatchNorm (ViT): the mutated collection comes back empty.
             logits, mutated = state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True,
@@ -51,7 +53,7 @@ def make_train_step(data_cfg: DataConfig,
             else:
                 losses = optax.softmax_cross_entropy_with_integer_labels(
                     logits, labels)
-            return losses.mean(), (logits, mutated["batch_stats"])
+            return losses.mean(), (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
