@@ -91,6 +91,13 @@ class ModelConfig:
     # (sequence-parallel over the mesh 'seq' axis).
     attention: str = "dense"
     attention_block: int = 512        # K/V chunk for attention="blockwise"
+    # Mixture-of-Experts (ViT family): 0 experts = dense MLPs. Experts
+    # are sharded over the mesh 'model' axis (expert parallelism).
+    moe_experts: int = 0
+    moe_every: int = 2                # sparse MLP in every Nth block
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01      # load-balance loss weight
     # Optional path to a torch state_dict (.pth) with ImageNet-pretrained
     # weights to convert (transfer learning is load-bearing for the ~96%
     # accuracy target — reference README.md:24-26).
@@ -212,6 +219,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "sequence-parallel over the mesh 'seq' axis")
     p.add_argument("--attention-block", type=int, default=None,
                    help="K/V chunk size for --attention blockwise")
+    p.add_argument("--moe-experts", type=int, default=None,
+                   help="experts per MoE block (ViT); 0 = dense MLPs")
+    p.add_argument("--moe-top-k", type=int, default=None)
+    p.add_argument("--moe-every", type=int, default=None)
+    p.add_argument("--moe-capacity-factor", type=float, default=None)
+    p.add_argument("--moe-aux-weight", type=float, default=None)
     p.add_argument("--vit-patch", type=int, default=None)
     p.add_argument("--vit-hidden", type=int, default=None)
     p.add_argument("--vit-depth", type=int, default=None)
@@ -261,7 +274,9 @@ def config_from_args(argv=None) -> TrainConfig:
         model = dataclasses.replace(model, attention=args.attention)
     if args.attention_block is not None:
         model = dataclasses.replace(model, attention_block=args.attention_block)
-    for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads"):
+    for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads",
+                 "moe_experts", "moe_top_k", "moe_every",
+                 "moe_capacity_factor", "moe_aux_weight"):
         val = getattr(args, name)
         if val is not None:
             model = dataclasses.replace(model, **{name: val})

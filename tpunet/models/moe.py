@@ -1,0 +1,113 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+The reference is a dense CNN (SURVEY.md 2b lists EP/MoE as absent);
+tpunet adds a ViT-MoE-style sparse MLP so expert parallelism is a real,
+tested strategy rather than an open mesh axis. Design follows the
+einsum dense-dispatch formulation (Mesh-TensorFlow / ViT-MoE / Switch):
+
+- Router: Dense(E) over tokens -> softmax probs -> top-k experts per
+  token (k=2 default), gate values renormalized over the selected k.
+- Capacity: each expert processes at most C = ceil(k*N/E * factor)
+  tokens; overflow tokens are dropped for that expert (their gate mass
+  simply doesn't contribute — standard Switch behavior). Position in
+  expert is assigned by token order via cumsum, all inside jit with
+  static shapes (no sorting, no dynamic shapes — XLA/MXU friendly).
+- Dispatch/combine are one-hot einsums; expert FFNs are a single
+  batched einsum over the expert dim ([E, d, h] / [E, h, d] params).
+- Expert parallelism = sharding the expert dim of those params over
+  the mesh 'model' axis (path rules in tpunet/parallel/tp.py); GSPMD
+  turns the dispatch einsums into the all-to-alls. No separate mesh
+  axis needed.
+- Load-balance aux loss (Shazeer et al.): E * sum_e(frac_dispatched_e
+  * mean_router_prob_e), sown into the 'losses' collection; the train
+  step adds cfg.moe_aux_weight * sum(losses) to the CE loss.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MoeMlp(nn.Module):
+    """Sparse MLP: top-k routed experts, capacity-bounded dense dispatch.
+
+    Input/output [B, T, d] — drop-in replacement for the dense MlpBlock.
+    """
+
+    num_experts: int
+    mlp_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, t, d = x.shape
+        e, k = self.num_experts, min(self.top_k, self.num_experts)
+        n = b * t
+        cap = max(k, math.ceil(k * n / e * self.capacity_factor))
+        tokens = x.reshape(n, d)
+
+        # Router in float32 — gate probabilities are numerically load-
+        # bearing and tiny relative to the FFN cost.
+        logits = nn.Dense(e, dtype=jnp.float32,
+                          param_dtype=jnp.float32,
+                          kernel_init=nn.initializers.normal(stddev=0.02),
+                          name="router")(tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # [n, e]
+
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)    # [n, k]
+        gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+        # Position of each (token, slot) inside its expert's buffer,
+        # slot-major so slot-0 assignments win buffer space first.
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [n,k,e]
+        flat = onehot.transpose(1, 0, 2).reshape(k * n, e)  # slot-major
+        pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0    # [k*n, e]
+        pos = pos_flat.reshape(k, n, e).transpose(1, 0, 2)  # [n, k, e]
+        fits = (pos >= 0) & (pos < cap)
+
+        # dispatch[n, e, c] in {0,1}; combine = dispatch * gate value.
+        pos_cap = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+        pos_onehot = jax.nn.one_hot(pos_cap, cap, dtype=jnp.float32)
+        kept = onehot * fits.astype(jnp.float32)            # [n, k, e]
+        dispatch = jnp.einsum("nke,nkec->nec", kept, pos_onehot)
+        combine = jnp.einsum("nke,nkec->nec",
+                             kept * gate_vals[:, :, None], pos_onehot)
+
+        # Load-balance aux loss (fraction dispatched x mean router prob).
+        frac = jnp.sum(dispatch, axis=(0, 2)) / jnp.maximum(
+            jnp.sum(dispatch), 1.0)                         # [e]
+        mean_prob = jnp.mean(probs, axis=0)                 # [e]
+        aux = e * jnp.sum(frac * mean_prob)
+        self.sow("losses", "moe_aux", aux)
+
+        # Expert FFN: one batched einsum pair over the expert dim; the
+        # expert axis of wi/wo is what expert parallelism shards.
+        wi = self.param("wi", nn.initializers.variance_scaling(
+            2.0, "fan_in", "truncated_normal"), (e, d, self.mlp_dim),
+            self.param_dtype)
+        bi = self.param("bi", nn.initializers.zeros, (e, self.mlp_dim),
+                        self.param_dtype)
+        wo = self.param("wo", nn.initializers.variance_scaling(
+            2.0, "fan_in", "truncated_normal"), (e, self.mlp_dim, d),
+            self.param_dtype)
+        bo = self.param("bo", nn.initializers.zeros, (e, d),
+                        self.param_dtype)
+
+        xin = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype),
+                         tokens.astype(self.dtype))
+        h = jnp.einsum("ecd,edf->ecf", xin, wi.astype(self.dtype))
+        h = nn.gelu(h + bi[:, None, :].astype(self.dtype))
+        out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+        out = out + bo[:, None, :].astype(self.dtype)
+        y = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), out)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return y.reshape(b, t, d)

@@ -87,11 +87,18 @@ class MlpBlock(nn.Module):
 
 
 class EncoderBlock(nn.Module):
-    """Pre-LN block: x + Attn(LN(x)); x + Mlp(LN(x))."""
+    """Pre-LN block: x + Attn(LN(x)); x + Mlp(LN(x)).
+
+    With ``moe_experts > 0`` the dense MLP is replaced by a top-k routed
+    MoE MLP (tpunet/models/moe.py) — expert-parallel over the mesh
+    'model' axis via the TP path rules."""
 
     heads: int
     mlp_dim: int
     attn_fn: AttnFn = dense_attention
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -105,10 +112,19 @@ class EncoderBlock(nn.Module):
                           param_dtype=self.param_dtype, name="attn")(y, train)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln2")(x)
-        x = x + MlpBlock(self.mlp_dim, dropout_rate=self.dropout_rate,
-                         dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="mlp")(y, train)
-        return x
+        if self.moe_experts > 0:
+            from tpunet.models.moe import MoeMlp
+            mlp_out = MoeMlp(self.moe_experts, self.mlp_dim,
+                             top_k=self.moe_top_k,
+                             capacity_factor=self.moe_capacity_factor,
+                             dropout_rate=self.dropout_rate,
+                             dtype=self.dtype, param_dtype=self.param_dtype,
+                             name="moe")(y, train)
+        else:
+            mlp_out = MlpBlock(self.mlp_dim, dropout_rate=self.dropout_rate,
+                               dtype=self.dtype, param_dtype=self.param_dtype,
+                               name="mlp")(y, train)
+        return x + mlp_out
 
 
 class ViT(nn.Module):
@@ -124,11 +140,17 @@ class ViT(nn.Module):
     mlp_ratio: float = 4.0
     dropout_rate: float = 0.0
     attn_fn: AttnFn = dense_attention
+    moe_experts: int = 0              # 0 = dense MLP everywhere
+    moe_every: int = 2                # MoE in every moe_every-th block
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.moe_experts > 0 and self.moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got {self.moe_every}")
         p = self.patch_size
         if x.shape[1] % p or x.shape[2] % p:
             raise ValueError(
@@ -145,8 +167,15 @@ class ViT(nn.Module):
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         for i in range(self.depth):
+            # ViT-MoE placement: sparse MLP in every moe_every-th block
+            # (the later block of each pair), dense elsewhere.
+            moe_here = (self.moe_experts > 0
+                        and i % self.moe_every == self.moe_every - 1)
             x = EncoderBlock(self.heads, int(self.hidden * self.mlp_ratio),
                              attn_fn=self.attn_fn,
+                             moe_experts=self.moe_experts if moe_here else 0,
+                             moe_top_k=self.moe_top_k,
+                             moe_capacity_factor=self.moe_capacity_factor,
                              dropout_rate=self.dropout_rate,
                              dtype=self.dtype, param_dtype=self.param_dtype,
                              name=f"block{i:02d}")(x, train)
@@ -206,6 +235,10 @@ def create_model(cfg: ModelConfig, mesh=None) -> ViT:
         mlp_ratio=cfg.vit_mlp_ratio,
         dropout_rate=cfg.dropout_rate,
         attn_fn=make_attn_fn(cfg, mesh),
+        moe_experts=cfg.moe_experts,
+        moe_every=cfg.moe_every,
+        moe_top_k=cfg.moe_top_k,
+        moe_capacity_factor=cfg.moe_capacity_factor,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),
     )

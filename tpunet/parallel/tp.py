@@ -33,7 +33,9 @@ from tpunet.config import ModelConfig
 
 Rules = Sequence[Tuple[str, P]]
 
-# Megatron-style ViT sharding (tpunet/models/vit.py module names).
+# Megatron-style ViT sharding (tpunet/models/vit.py module names), plus
+# expert parallelism: MoE expert params ([E, ...]) shard their expert
+# dim over 'model' (tpunet/models/moe.py; the router stays replicated).
 VIT_TP_RULES: Rules = (
     (r"attn/qkv/kernel$", P(None, "model")),      # column parallel
     (r"attn/qkv/bias$", P("model")),
@@ -41,6 +43,10 @@ VIT_TP_RULES: Rules = (
     (r"mlp/fc1/kernel$", P(None, "model")),       # column parallel
     (r"mlp/fc1/bias$", P("model")),
     (r"mlp/fc2/kernel$", P("model", None)),       # row parallel
+    (r"moe/wi$", P("model", None, None)),         # expert parallel
+    (r"moe/bi$", P("model", None)),
+    (r"moe/wo$", P("model", None, None)),
+    (r"moe/bo$", P("model", None)),
 )
 
 

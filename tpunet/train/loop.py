@@ -62,7 +62,7 @@ class Trainer:
         # returned state, which would then mismatch in_shardings on the
         # next call.
         self.train_step = jax.jit(
-            make_train_step(cfg.data, cfg.optim),
+            make_train_step(cfg.data, cfg.optim, cfg.model),
             in_shardings=(state_sh, bsh, bsh, repl),
             out_shardings=(state_sh, repl),
             donate_argnums=0)

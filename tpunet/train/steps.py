@@ -12,20 +12,21 @@ replicated) rather than by framework hooks.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from tpunet.config import DataConfig, OptimConfig
+from tpunet.config import DataConfig, ModelConfig, OptimConfig
 from tpunet.data.augment import make_eval_preprocess, make_train_augment
 from tpunet.train import metrics as M
 from tpunet.train.state import TrainState
 
 
 def make_train_step(data_cfg: DataConfig,
-                    optim_cfg: OptimConfig) -> Callable:
+                    optim_cfg: OptimConfig,
+                    model_cfg: Optional[ModelConfig] = None) -> Callable:
     """Build train_step(state, images_u8, labels, rng) -> (state, metrics).
 
     ``images_u8`` is the raw (global_batch, 32, 32, 3) uint8 batch;
@@ -33,6 +34,7 @@ def make_train_step(data_cfg: DataConfig,
     """
     augment = make_train_augment(data_cfg)
     smoothing = optim_cfg.label_smoothing
+    aux_weight = model_cfg.moe_aux_weight if model_cfg is not None else 0.0
 
     def train_step(state: TrainState, images_u8, labels, rng):
         aug_rng, dropout_rng = jax.random.split(rng)
@@ -41,11 +43,12 @@ def make_train_step(data_cfg: DataConfig,
         def loss_fn(params):
             # mutable=["batch_stats"] is harmless for models without
             # BatchNorm (ViT): the mutated collection comes back empty.
+            # "losses" carries MoE load-balance terms sown by MoeMlp.
             logits, mutated = state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True,
                 rngs={"dropout": dropout_rng},
-                mutable=["batch_stats"])
+                mutable=["batch_stats", "losses"])
             if smoothing > 0:
                 losses = optax.softmax_cross_entropy(
                     logits, optax.smooth_labels(
@@ -53,7 +56,11 @@ def make_train_step(data_cfg: DataConfig,
             else:
                 losses = optax.softmax_cross_entropy_with_integer_labels(
                     logits, labels)
-            return losses.mean(), (logits, mutated.get("batch_stats", {}))
+            loss = losses.mean()
+            aux_terms = jax.tree_util.tree_leaves(mutated.get("losses", {}))
+            if aux_terms and aux_weight > 0:
+                loss = loss + aux_weight * sum(aux_terms)
+            return loss, (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
