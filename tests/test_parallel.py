@@ -13,9 +13,9 @@ from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
 def test_default_mesh_uses_all_devices():
     mesh = make_mesh(MeshConfig())
     assert mesh.devices.size == 8
-    assert mesh.axis_names == ("data", "seq", "model")
+    assert mesh.axis_names == ("data", "seq", "pipe", "model")
     assert mesh.shape["data"] == 8 and mesh.shape["model"] == 1
-    assert mesh.shape["seq"] == 1
+    assert mesh.shape["seq"] == 1 and mesh.shape["pipe"] == 1
 
 
 def test_explicit_mesh_shape():

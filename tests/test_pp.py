@@ -1,0 +1,190 @@
+"""Pipeline parallelism: GPipe executor correctness, pipelined-ViT
+parity with its own sequential path, and training through the Trainer
+on a dp x pp mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, OptimConfig, TrainConfig)
+from tpunet.models import create_model, init_variables
+from tpunet.parallel import make_mesh
+from tpunet.parallel.pp import gpipe
+from tpunet.train.loop import Trainer
+
+PP_CFG = ModelConfig(name="vit_pp", vit_patch=4, vit_hidden=64,
+                     vit_depth=4, vit_heads=4, dropout_rate=0.0,
+                     dtype="float32", pp_microbatches=4)
+
+
+def _stage_apply(params, x):
+    """Toy stage: scan of affine+tanh layers, params['w'] [L, C, C]."""
+    def body(carry, pl):
+        return jnp.tanh(carry @ pl["w"] + pl["b"]), None
+    out, _ = jax.lax.scan(body, x, params)
+    return out
+
+
+def _toy(depth=4, c=8):
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(depth, c, c)) * 0.5, jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(depth, c)) * 0.1, jnp.float32),
+    }
+    x = jnp.asarray(rng.normal(size=(8, 6, c)), jnp.float32)
+    return params, x
+
+
+@pytest.mark.parametrize("pipe,n_micro", [(2, 2), (4, 4), (2, 4), (4, 2)])
+def test_gpipe_matches_sequential(pipe, n_micro):
+    params, x = _toy()
+    mesh = make_mesh(MeshConfig(data=2, pipe=pipe))
+    out = gpipe(_stage_apply, params, x, mesh=mesh, n_micro=n_micro)
+    ref = _stage_apply(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_single_stage_is_sequential():
+    params, x = _toy()
+    mesh = make_mesh(MeshConfig(data=2, pipe=1))
+    out = gpipe(_stage_apply, params, x, mesh=mesh, n_micro=2)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_stage_apply(params, x)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_gradients_match_sequential():
+    params, x = _toy()
+    mesh = make_mesh(MeshConfig(data=2, pipe=2))
+
+    def loss_pp(p):
+        return jnp.sum(gpipe(_stage_apply, p, x, mesh=mesh, n_micro=2) ** 2)
+
+    def loss_seq(p):
+        return jnp.sum(_stage_apply(p, x) ** 2)
+
+    g_pp = jax.grad(loss_pp)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_pp[k]),
+                                   np.asarray(g_seq[k]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_gpipe_rejects_indivisible_microbatch():
+    params, x = _toy()  # batch 8 -> local 4 per data shard
+    mesh = make_mesh(MeshConfig(data=2, pipe=2))
+    with pytest.raises(ValueError):
+        gpipe(_stage_apply, params, x, mesh=mesh, n_micro=3)
+
+
+def test_pipelined_vit_matches_own_sequential_path():
+    """Same params: pipelined forward (pipe=4) == sequential scan."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    pp_model = create_model(PP_CFG, mesh=mesh)
+    seq_model = create_model(PP_CFG, mesh=None)
+    variables = init_variables(seq_model, jax.random.PRNGKey(0),
+                               image_size=32, batch_size=8)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(8, 32, 32, 3)),
+                    jnp.float32)
+    a = pp_model.apply(variables, x, train=False)
+    b = seq_model.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipelined_vit_matches_dense_vit_logits():
+    """vit_pp's hand-rolled block math == the flax-module dense ViT,
+    with vit params mapped into the stacked layout (pins the duplicated
+    encoder math: LN eps/upcast, gelu variant, qkv reshape order)."""
+    vit_cfg = dataclasses.replace(PP_CFG, name="vit")
+    vit_model = create_model(vit_cfg)
+    vit_vars = init_variables(vit_model, jax.random.PRNGKey(0),
+                              image_size=32)
+    vp = vit_vars["params"]
+    L = PP_CFG.vit_depth
+    stack = lambda f: jnp.stack([f(vp[f"block{i:02d}"]) for i in range(L)])
+    pp_params = {
+        "patch_embed": vp["patch_embed"],
+        "pos_embed": vp["pos_embed"],
+        "ln": vp["ln"],
+        "classifier": vp["classifier"],
+        "blocks_ln1s": stack(lambda b: b["ln1"]["scale"]),
+        "blocks_ln1b": stack(lambda b: b["ln1"]["bias"]),
+        "blocks_qkv_k": stack(lambda b: b["attn"]["qkv"]["kernel"]),
+        "blocks_qkv_b": stack(lambda b: b["attn"]["qkv"]["bias"]),
+        "blocks_out_k": stack(lambda b: b["attn"]["out"]["kernel"]),
+        "blocks_out_b": stack(lambda b: b["attn"]["out"]["bias"]),
+        "blocks_ln2s": stack(lambda b: b["ln2"]["scale"]),
+        "blocks_ln2b": stack(lambda b: b["ln2"]["bias"]),
+        "blocks_fc1_k": stack(lambda b: b["mlp"]["fc1"]["kernel"]),
+        "blocks_fc1_b": stack(lambda b: b["mlp"]["fc1"]["bias"]),
+        "blocks_fc2_k": stack(lambda b: b["mlp"]["fc2"]["kernel"]),
+        "blocks_fc2_b": stack(lambda b: b["mlp"]["fc2"]["bias"]),
+    }
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(8, 32, 32, 3)),
+                    jnp.float32)
+    ref = vit_model.apply(vit_vars, x, train=False)
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    pp_model = create_model(PP_CFG, mesh=mesh)
+    out = pp_model.apply({"params": pp_params}, x, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_vit_pp_rejects_unsupported_features():
+    with pytest.raises(ValueError):
+        create_model(dataclasses.replace(PP_CFG, attention="ring"))
+    with pytest.raises(ValueError):
+        create_model(dataclasses.replace(PP_CFG, moe_experts=4))
+
+
+def test_depth_not_divisible_by_stages_raises():
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    with pytest.raises(ValueError):
+        create_model(dataclasses.replace(PP_CFG, vit_depth=6), mesh=mesh)
+
+
+def _cfg(mesh_cfg, **model_kw):
+    return TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic", image_size=32, batch_size=32,
+                        synthetic_train_size=64, synthetic_test_size=32),
+        model=dataclasses.replace(PP_CFG, **model_kw),
+        optim=OptimConfig(learning_rate=1e-3),
+        mesh=mesh_cfg,
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+
+
+def test_pp_training_parity_with_dp_only():
+    def run(mesh_cfg):
+        tr = Trainer(_cfg(mesh_cfg))
+        try:
+            train_m = tr.train_one_epoch(1)
+            eval_m = tr.evaluate()
+        finally:
+            tr.close()
+        return train_m, eval_m
+
+    base_t, base_e = run(MeshConfig(data=2))
+    pp_t, pp_e = run(MeshConfig(data=2, pipe=4))
+    assert abs(base_t["loss"] - pp_t["loss"]) < 1e-4
+    assert abs(base_e["accuracy"] - pp_e["accuracy"]) < 1e-6
+
+    # stacked block params actually sharded over 'pipe'
+    from jax.sharding import PartitionSpec as P
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    tr = Trainer(_cfg(MeshConfig(data=2, pipe=4)), mesh=mesh)
+    try:
+        qkv = tr.state.params["blocks_qkv_k"]
+        assert qkv.sharding.spec == P("pipe")
+        mu = tr.state.opt_state[0].mu["blocks_qkv_k"]
+        assert mu.sharding.spec == P("pipe")
+    finally:
+        tr.close()

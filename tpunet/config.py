@@ -98,6 +98,9 @@ class ModelConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01      # load-balance loss weight
+    # Pipeline parallelism (model name "vit_pp"): GPipe microbatches per
+    # step; stages = the mesh 'pipe' axis size.
+    pp_microbatches: int = 4
     # Optional path to a torch state_dict (.pth) with ImageNet-pretrained
     # weights to convert (transfer learning is load-bearing for the ~96%
     # accuracy target — reference README.md:24-26).
@@ -127,21 +130,24 @@ class OptimConfig:
 @dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh config. The reference's only strategy is data parallelism
-    (DDP at :142-145); we build a 3-D ('data', 'seq', 'model') mesh so
-    sequence parallelism (ring attention over 'seq') and tensor-parallel
-    sharding (over 'model') layer on without restructuring (SURVEY.md 2b).
+    (DDP at :142-145); we build a 4-D ('data', 'seq', 'pipe', 'model')
+    mesh so sequence parallelism (ring attention over 'seq'), pipeline
+    parallelism (GPipe over 'pipe') and tensor/expert-parallel sharding
+    (over 'model') layer on without restructuring (SURVEY.md 2b).
     """
 
     data: int = -1                    # -1 -> all remaining devices
     seq: int = 1                      # sequence/context-parallel axis
+    pipe: int = 1                     # pipeline-parallel axis (GPipe)
     model: int = 1                    # tensor-parallel axis
 
-    def shape(self, n_devices: int) -> Tuple[int, int, int]:
+    def shape(self, n_devices: int) -> Tuple[int, int, int, int]:
         seq = max(1, self.seq)
+        pipe = max(1, self.pipe)
         model = max(1, self.model)
         data = (self.data if self.data > 0
-                else max(1, n_devices // (seq * model)))
-        return (data, seq, model)
+                else max(1, n_devices // (seq * pipe * model)))
+        return (data, seq, pipe, model)
 
 
 @dataclass(frozen=True)
@@ -212,7 +218,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="path to a torch MobileNetV2 state_dict to convert")
     p.add_argument("--model", default=None,
                    choices=["mobilenet_v2", "vit", "vit_tiny", "vit_small",
-                            "vit_base"])
+                            "vit_base", "vit_pp"])
+    p.add_argument("--pp-microbatches", type=int, default=None,
+                   help="GPipe microbatches per step (vit_pp)")
     p.add_argument("--attention", default=None,
                    choices=["dense", "blockwise", "ring"],
                    help="core attention impl for ViT models; 'ring' is "
@@ -237,6 +245,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-data", type=int, default=None)
     p.add_argument("--mesh-seq", type=int, default=None,
                    help="sequence-parallel axis size (ring attention)")
+    p.add_argument("--mesh-pipe", type=int, default=None,
+                   help="pipeline-parallel axis size (vit_pp model)")
     p.add_argument("--mesh-model", type=int, default=None,
                    help="tensor-parallel axis size")
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
@@ -276,7 +286,8 @@ def config_from_args(argv=None) -> TrainConfig:
         model = dataclasses.replace(model, attention_block=args.attention_block)
     for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads",
                  "moe_experts", "moe_top_k", "moe_every",
-                 "moe_capacity_factor", "moe_aux_weight"):
+                 "moe_capacity_factor", "moe_aux_weight",
+                 "pp_microbatches"):
         val = getattr(args, name)
         if val is not None:
             model = dataclasses.replace(model, **{name: val})
@@ -292,6 +303,8 @@ def config_from_args(argv=None) -> TrainConfig:
         mesh = dataclasses.replace(mesh, data=args.mesh_data)
     if args.mesh_seq is not None:
         mesh = dataclasses.replace(mesh, seq=args.mesh_seq)
+    if args.mesh_pipe is not None:
+        mesh = dataclasses.replace(mesh, pipe=args.mesh_pipe)
     if args.mesh_model is not None:
         mesh = dataclasses.replace(mesh, model=args.mesh_model)
     if args.checkpoint_dir is not None:

@@ -21,9 +21,12 @@ from tpunet.models.vit import ViT, VIT_PRESETS  # noqa: F401
 
 def create_model(cfg: ModelConfig, mesh=None):
     """Build the configured model. ``mesh`` is needed only by models
-    whose attention runs sequence-parallel (attention='ring')."""
+    that run shard_map internally (ring attention, pipeline)."""
     if cfg.name == "mobilenet_v2":
         return mobilenetv2.create_model(cfg)
+    if cfg.name == "vit_pp":
+        from tpunet.models import vit_pp
+        return vit_pp.create_model(cfg, mesh=mesh)
     if cfg.name == "vit" or cfg.name in VIT_PRESETS:
         return vit.create_model(cfg, mesh=mesh)
     raise ValueError(f"unknown model {cfg.name!r}")

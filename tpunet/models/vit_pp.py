@@ -1,0 +1,177 @@
+"""Pipeline-parallel ViT ("vit_pp").
+
+Same architecture as tpunet/models/vit.py (pre-LN encoder, mean-pooled
+tokens, linear head) but the encoder blocks are expressed as *stacked
+functional parameters* — every weight has a leading ``depth`` dim — so
+pipeline parallelism is just a sharding: the leading dim is split over
+the mesh 'pipe' axis (path rule in tpunet/parallel/tp.py) and the GPipe
+executor (tpunet/parallel/pp.py) streams microbatches through the
+stages with ppermute hops.
+
+With pipe == 1 (or mesh=None, e.g. single-chip serving) the same
+stacked params run as a plain ``lax.scan`` over layers — bitwise the
+same math, which is exactly what the parity tests assert.
+
+Patch embed, final LN and the classifier head are tiny; they run
+replicated on every pipe stage rather than being assigned to first/last
+stages (standard trick — keeps the pipeline body uniform).
+
+Differences from the dense ViT (documented, deliberate): no dropout
+inside pipelined blocks, dense attention only (ring attention's own
+shard_map cannot nest inside the pipeline's).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpunet.config import ModelConfig
+from tpunet.ops import dense_attention
+from tpunet.parallel.pp import gpipe
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    # Statistics in float32 regardless of compute dtype, matching flax
+    # nn.LayerNorm's upcast behavior in the dense ViT.
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def block_apply(p, x, *, heads):
+    """One pre-LN encoder block from a dict of per-layer params."""
+    mb, t, c = x.shape
+    y = _layer_norm(x, p["ln1s"], p["ln1b"])
+    qkv = y @ p["qkv_k"] + p["qkv_b"]
+    qkv = qkv.reshape(mb, t, 3, heads, c // heads)
+    a = dense_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    x = x + a.reshape(mb, t, c) @ p["out_k"] + p["out_b"]
+    y = _layer_norm(x, p["ln2s"], p["ln2b"])
+    h = nn.gelu(y @ p["fc1_k"] + p["fc1_b"])
+    return x + h @ p["fc2_k"] + p["fc2_b"]
+
+
+class PipelinedViT(nn.Module):
+    """ViT with stacked encoder params, pipelined over 'pipe'."""
+
+    num_classes: int = 10
+    patch_size: int = 4
+    hidden: int = 64
+    depth: int = 4
+    heads: int = 4
+    mlp_ratio: float = 4.0
+    n_micro: int = 4
+    mesh: Any = None                   # jax.sharding.Mesh or None
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.hidden % self.heads:
+            raise ValueError(f"hidden {self.hidden} not divisible by "
+                             f"{self.heads} heads")
+        p = self.patch_size
+        if x.shape[1] % p or x.shape[2] % p:
+            raise ValueError(f"image {x.shape[1]}x{x.shape[2]} not "
+                             f"divisible by patch {p}")
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.hidden, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="patch_embed")(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
+                         (1, h * w, c), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+
+        ln_ones = nn.initializers.ones
+        zeros = nn.initializers.zeros
+        winit = nn.initializers.normal(stddev=0.02)
+        L, C, H = self.depth, c, int(self.hidden * self.mlp_ratio)
+        blocks = {
+            "ln1s": self.param("blocks_ln1s", ln_ones, (L, C),
+                               self.param_dtype),
+            "ln1b": self.param("blocks_ln1b", zeros, (L, C),
+                               self.param_dtype),
+            "qkv_k": self.param("blocks_qkv_k", winit, (L, C, 3 * C),
+                                self.param_dtype),
+            "qkv_b": self.param("blocks_qkv_b", zeros, (L, 3 * C),
+                                self.param_dtype),
+            "out_k": self.param("blocks_out_k", winit, (L, C, C),
+                                self.param_dtype),
+            "out_b": self.param("blocks_out_b", zeros, (L, C),
+                                self.param_dtype),
+            "ln2s": self.param("blocks_ln2s", ln_ones, (L, C),
+                               self.param_dtype),
+            "ln2b": self.param("blocks_ln2b", zeros, (L, C),
+                               self.param_dtype),
+            "fc1_k": self.param("blocks_fc1_k", winit, (L, C, H),
+                                self.param_dtype),
+            "fc1_b": self.param("blocks_fc1_b", zeros, (L, H),
+                                self.param_dtype),
+            "fc2_k": self.param("blocks_fc2_k", winit, (L, H, C),
+                                self.param_dtype),
+            "fc2_b": self.param("blocks_fc2_b", zeros, (L, C),
+                                self.param_dtype),
+        }
+        blocks = jax.tree_util.tree_map(
+            lambda a: a.astype(self.dtype), blocks)
+        heads = self.heads
+
+        def stage_apply(params, xs):
+            def body(carry, pl):
+                return block_apply(pl, carry, heads=heads), None
+            out, _ = jax.lax.scan(body, xs, params)
+            return out
+
+        if self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1:
+            x = gpipe(stage_apply, blocks, x, mesh=self.mesh,
+                      n_micro=self.n_micro)
+        else:
+            x = stage_apply(blocks, x)
+
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln")(x)
+        x = jnp.mean(x, axis=1)
+        x = nn.Dense(self.num_classes,
+                     kernel_init=nn.initializers.zeros_init(),
+                     dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
+    """Build a PipelinedViT. Unsupported 'vit' features fail loudly
+    (dropout is the documented exception: pipelined blocks run without
+    it; the config field only affects the dense ViT)."""
+    if cfg.attention != "dense":
+        raise ValueError(
+            f"vit_pp supports dense attention only (got "
+            f"{cfg.attention!r}); ring/blockwise cannot nest inside the "
+            "pipeline's shard_map")
+    if cfg.moe_experts > 0:
+        raise ValueError("vit_pp does not support MoE blocks")
+    if mesh is not None:
+        stages = mesh.shape.get("pipe", 1)
+        if stages > 1 and cfg.vit_depth % stages:
+            raise ValueError(f"vit_depth {cfg.vit_depth} not divisible by "
+                             f"{stages} pipeline stages")
+    return PipelinedViT(
+        num_classes=cfg.num_classes,
+        patch_size=cfg.vit_patch,
+        hidden=cfg.vit_hidden,
+        depth=cfg.vit_depth,
+        heads=cfg.vit_heads,
+        mlp_ratio=cfg.vit_mlp_ratio,
+        n_micro=cfg.pp_microbatches,
+        mesh=mesh,
+        dtype=jnp.dtype(cfg.dtype),
+        param_dtype=jnp.dtype(cfg.param_dtype),
+    )

@@ -9,11 +9,13 @@ batch sharded on the ``data`` axis and parameters replicated — XLA then
 inserts the gradient all-reduce (over ICI on a TPU slice) itself, fused
 into the step program.
 
-The mesh is 3-D ``('data', 'seq', 'model')``: the reference is DP-only
-(SURVEY.md section 2b), and the extra axes carry sequence parallelism
-(ring attention rotates K/V over 'seq' — tpunet/ops/attention.py) and
-tensor-parallel param sharding (tpunet/parallel/tp.py) without
-restructuring. Unused axes have size 1 and cost nothing.
+The mesh is 4-D ``('data', 'seq', 'pipe', 'model')``: the reference is
+DP-only (SURVEY.md section 2b), and the extra axes carry sequence
+parallelism (ring attention rotates K/V over 'seq' —
+tpunet/ops/attention.py), pipeline parallelism (GPipe microbatches over
+'pipe' — tpunet/parallel/pp.py) and tensor/expert-parallel param
+sharding (tpunet/parallel/tp.py) without restructuring. Unused axes
+have size 1 and cost nothing.
 """
 
 from __future__ import annotations
@@ -32,17 +34,17 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     cfg = cfg or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
-    data, seq, model = cfg.shape(len(devices))
-    n = data * seq * model
+    data, seq, pipe, model = cfg.shape(len(devices))
+    n = data * seq * pipe * model
     if n > len(devices):
-        raise ValueError(f"mesh {data}x{seq}x{model} needs {n} devices, "
-                         f"have {len(devices)}")
+        raise ValueError(f"mesh {data}x{seq}x{pipe}x{model} needs {n} "
+                         f"devices, have {len(devices)}")
     if n == len(devices):
-        dmesh = mesh_utils.create_device_mesh((data, seq, model),
+        dmesh = mesh_utils.create_device_mesh((data, seq, pipe, model),
                                               devices=devices)
     else:
-        dmesh = np.asarray(devices[:n]).reshape(data, seq, model)
-    return Mesh(dmesh, ("data", "seq", "model"))
+        dmesh = np.asarray(devices[:n]).reshape(data, seq, pipe, model)
+    return Mesh(dmesh, ("data", "seq", "pipe", "model"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

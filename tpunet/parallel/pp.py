@@ -1,0 +1,102 @@
+"""Pipeline parallelism: GPipe-style SPMD executor over the 'pipe' axis.
+
+The reference has no pipeline parallelism (single-file model, SURVEY.md
+2b); tpunet implements it the TPU way: no per-stage processes, no
+send/recv threads — ONE jitted SPMD program in which every device runs
+the same code, holds one pipeline stage's worth of stacked layer
+parameters (leading dim sharded over 'pipe'), and activations hop
+stage-to-stage with ``lax.ppermute`` (one ICI neighbor hop per tick).
+
+Schedule: plain GPipe with M microbatches over S stages; the static
+scan runs M + S - 1 ticks. At tick t, stage s computes microbatch
+m = t - s (masked out when m is out of range — idle bubble ticks
+compute on zeros and are discarded). Stage 0 reads microbatches from
+the (replicated) input; stage S-1 accumulates results into the output
+buffer, which a final psum over 'pipe' replicates (all other stages
+contribute zeros).
+
+Differentiable end-to-end: reverse-mode AD through scan + ppermute
+yields the standard backward pipeline (the transpose of a shifted
+ppermute is the reverse shift).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def gpipe(stage_apply: Callable, stacked_params, x, *,
+          mesh: Mesh, n_micro: int, axis_name: str = "pipe",
+          data_axis: str = "data"):
+    """Run ``x`` through all pipeline stages.
+
+    stage_apply(local_params, x_micro) applies one stage's layer stack
+    to one microbatch; it is called inside shard_map, where every leaf
+    of ``local_params`` is the device-local slice (leading dim
+    total_layers/S) of ``stacked_params``.
+
+    x: [B, T, C] (batch sharded over ``data_axis``); returns [B, T, C].
+    """
+    n_stages = mesh.shape[axis_name]
+    if n_stages == 1:
+        return stage_apply(stacked_params, x)
+
+    p_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    x_spec = P(data_axis, None, None)
+
+    fn = jax.shard_map(
+        functools.partial(_gpipe_body, stage_apply, n_micro=n_micro,
+                          axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(p_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False)
+    return fn(stacked_params, x)
+
+
+def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name):
+    s = jax.lax.axis_index(axis_name)
+    n_stages = jax.lax.psum(1, axis_name)
+    bl, t, c = xl.shape
+    if bl % n_micro:
+        raise ValueError(f"local batch {bl} not divisible by "
+                         f"{n_micro} microbatches")
+    mb = bl // n_micro
+    xm = xl.reshape(n_micro, mb, t, c)
+    perm = [(i, i + 1) for i in range(n_stages - 1)]  # no wraparound
+
+    def tick(carry, step):
+        act_in, outbuf = carry
+        m = step - s
+        valid = (m >= 0) & (m < n_micro)
+        mc = jnp.clip(m, 0, n_micro - 1)
+        inp = jnp.where(s == 0,
+                        jax.lax.dynamic_index_in_dim(xm, mc, 0,
+                                                     keepdims=False),
+                        act_in)
+        y = stage_apply(local_params, inp)
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        is_last = s == n_stages - 1
+        outbuf = jax.lax.dynamic_update_index_in_dim(
+            outbuf,
+            jnp.where(valid & is_last, y,
+                      jax.lax.dynamic_index_in_dim(outbuf, mc, 0,
+                                                   keepdims=False)),
+            mc, 0)
+        act_next = jax.lax.ppermute(y, axis_name, perm)
+        return (act_next, outbuf), None
+
+    act0 = jnp.zeros((mb, t, c), xl.dtype)
+    outbuf = jnp.zeros_like(xm)
+    (_, outbuf), _ = jax.lax.scan(
+        tick, (act0, outbuf), jnp.arange(n_micro + n_stages - 1))
+    # Only the last stage wrote real activations; psum replicates them.
+    outbuf = jax.lax.psum(
+        jnp.where(s == n_stages - 1, outbuf, jnp.zeros_like(outbuf)),
+        axis_name)
+    return outbuf.reshape(bl, t, c)

@@ -50,10 +50,20 @@ VIT_TP_RULES: Rules = (
 )
 
 
+# Pipeline-parallel ViT: every stacked block param ([depth, ...],
+# tpunet/models/vit_pp.py) shards its leading layer dim over 'pipe' —
+# contiguous chunks, i.e. one stage's layers per device.
+VIT_PP_RULES: Rules = (
+    (r"blocks_\w+$", P("pipe")),
+)
+
+
 def rules_for(cfg: ModelConfig) -> Rules:
     """TP rules for the configured model. MobileNetV2 stays replicated —
     at 2.2M params a CNN gains nothing from weight sharding (the
     reference's replicated layout is already right for it)."""
+    if cfg.name == "vit_pp":
+        return VIT_PP_RULES
     if cfg.name == "vit" or cfg.name.startswith("vit_"):
         return VIT_TP_RULES
     return ()

@@ -63,11 +63,16 @@ def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
     without BatchNorm (the ViT family).
     """
     model = create_model(model_cfg, mesh=mesh)
-    # Only ring attention runs shard_map at init, and only the batch dim's
-    # 'data' axis constrains it; everything else initializes with batch 1.
-    init_batch = (mesh.shape["data"]
-                  if mesh is not None and model_cfg.attention == "ring"
-                  else 1)
+    # Models that run shard_map internally constrain the init batch:
+    # ring attention shards it over 'data'; the pipeline additionally
+    # splits the local batch into microbatches. Everything else
+    # initializes with batch 1.
+    init_batch = 1
+    if mesh is not None:
+        if model_cfg.name == "vit_pp" and mesh.shape.get("pipe", 1) > 1:
+            init_batch = mesh.shape["data"] * model_cfg.pp_microbatches
+        elif model_cfg.attention == "ring":
+            init_batch = mesh.shape["data"]
     variables = init_variables(model, rng, image_size=image_size,
                                batch_size=init_batch)
     if model_cfg.pretrained_path:
