@@ -34,6 +34,17 @@ from tpunet.ops import dense_attention
 from tpunet.parallel.pp import gpipe
 
 
+def _stacked_lecun_normal(key, shape, dtype=jnp.float32):
+    """lecun_normal per layer for stacked [depth, fan_in, fan_out]
+    kernels: fan_in is shape[-2] only — flax's variance_scaling would
+    fold the stacked depth dim into the fan, and nn.Dense in the dense
+    ViT uses lecun_normal, which this matches exactly (truncated normal,
+    stddev correction 1/.87962566)."""
+    fan_in = shape[-2]
+    std = (1.0 / fan_in) ** 0.5 / 0.87962566103423978
+    return std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+
 def _layer_norm(x, scale, bias, eps=1e-6):
     # Statistics in float32 regardless of compute dtype, matching flax
     # nn.LayerNorm's upcast behavior in the dense ViT.
@@ -93,7 +104,7 @@ class PipelinedViT(nn.Module):
 
         ln_ones = nn.initializers.ones
         zeros = nn.initializers.zeros
-        winit = nn.initializers.normal(stddev=0.02)
+        winit = _stacked_lecun_normal
         L, C, H = self.depth, c, int(self.hidden * self.mlp_ratio)
         blocks = {
             "ln1s": self.param("blocks_ln1s", ln_ones, (L, C),

@@ -46,6 +46,13 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     if n_stages == 1:
         return stage_apply(stacked_params, x)
 
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
+        if leaf.shape[0] % n_stages:
+            raise ValueError(
+                f"stacked param {jax.tree_util.keystr(path)} has leading "
+                f"(layer) dim {leaf.shape[0]} not divisible by "
+                f"{n_stages} pipeline stages")
+
     p_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     x_spec = P(data_axis, None, None)
 
