@@ -110,7 +110,8 @@ def test_model_flag_same_params_same_logits(monkeypatch):
         ops, "depthwise_conv3x3",
         lambda x, w, stride=1, interpret=None: orig(x, w, stride, True))
 
-    cfg = ModelConfig(dtype="float32", width_mult=0.5)
+    cfg = ModelConfig(dtype="float32", width_mult=0.5,
+                      use_pallas_depthwise=False)  # explicit: XLA path
     ref = create_model(cfg)
     pal = create_model(dataclasses.replace(cfg, use_pallas_depthwise=True))
     variables = init_variables(ref, jax.random.PRNGKey(0), image_size=32)

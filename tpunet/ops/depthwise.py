@@ -7,9 +7,12 @@ first-class kernel). Design:
 - Input is pre-padded by one pixel (XLA fuses the pad), so the kernel
   body is 9 shifted multiply-adds over a VMEM-resident image — pure VPU
   work with no bounds logic. Channels ride the lane dimension (NHWC).
-- Grid is (batch,); each program owns one image. MobileNetV2's largest
-  depthwise activation (112x112x96) is ~2.5 MB in bfloat16, so the
-  whole image + output fit VMEM comfortably.
+- Grid is (batch, row_stripes); each program computes one stripe of
+  output rows, slicing its input rows (+2-row halo) from the resident
+  padded image with ``pl.ds``. Whole-image programs would overflow the
+  16 MB scoped-vmem stack: the 9 float32 tap temporaries at a 112x112
+  layer alone are ~14 MB (stride 2's slice/reshape trick reads ~4x
+  more, so the stripe height budget is stride-aware — ``_pick_rows``).
 - Stride 2 is expressed as slice + reshape + take (no strided vector
   slices, which Mosaic handles poorly).
 - Accumulation in float32 regardless of compute dtype; output cast back.
@@ -55,15 +58,36 @@ def _tap(x, dy: int, dx: int, ho: int, wo: int, stride: int):
     return v.reshape(ho, wo, stride, c)[:, :, 0]
 
 
-def _kernel(x_ref, w_ref, o_ref, *, ho: int, wo: int, stride: int):
-    x = x_ref[0]                       # (Hp, Wp, C)
+def _kernel(x_ref, w_ref, o_ref, *, wo: int, stride: int, rows: int):
+    """Compute one ``rows``-high output stripe per grid step. The 9
+    float32 tap temporaries are stripe-sized, not image-sized —
+    computing the whole image in one program overflows the 16 MB
+    scoped-vmem stack at the 224px workload's 112x112 layers (9 taps x
+    112x112xC x 4B; an in-kernel loop doesn't help because Mosaic's
+    stack allocator sums the iterations' temporaries)."""
     w = w_ref[:]                       # (3, 3, C)
-    acc = jnp.zeros((ho, wo, x.shape[-1]), jnp.float32)
+    c = x_ref.shape[-1]
+    bh = stride * rows + 2             # input rows feeding one stripe
+                                       # (max tap offset dy=2 + stride*rows)
+    r0 = pl.program_id(1) * rows
+    xs = x_ref[0, pl.ds(r0 * stride, bh)]   # (bh, Wp, C) stripe
+    acc = jnp.zeros((rows, wo, c), jnp.float32)
     for dy in range(3):
         for dx in range(3):
-            t = _tap(x, dy, dx, ho, wo, stride).astype(jnp.float32)
+            t = _tap(xs, dy, dx, rows, wo, stride).astype(jnp.float32)
             acc = acc + t * w[dy, dx].astype(jnp.float32)
     o_ref[0] = acc.astype(o_ref.dtype)
+
+
+def _pick_rows(ho: int, wo: int, c: int, stride: int) -> int:
+    """Largest divisor of ho whose stripe temporaries (~12 f32 buffers:
+    9 taps + accumulator + slack; stride 2's slice/reshape trick reads
+    ~stride^2 x more elements per tap) stay within a ~4 MB budget."""
+    budget = 4 * 1024 * 1024
+    for rows in range(ho, 0, -1):
+        if ho % rows == 0 and rows * wo * c * 4 * 12 * stride**2 <= budget:
+            return rows
+    return 1
 
 
 def _pallas_forward(x: jax.Array, w: jax.Array, stride: int,
@@ -73,21 +97,26 @@ def _pallas_forward(x: jax.Array, w: jax.Array, stride: int,
     wo = (w_in - 1) // stride + 1
     # Pad so every tap's full slice (stride*ho rows from offset <=2, for
     # the stride>1 reshape trick) stays in bounds; the extra zero rows
-    # land only in discarded reshape positions.
+    # land only in discarded reshape positions. The last stripe's
+    # dynamic_slice ends exactly at hp = stride*ho + 2, in bounds.
     pad_b = stride * ho + 1 - h
     pad_r = stride * wo + 1 - w_in
     xp = jnp.pad(x, ((0, 0), (1, pad_b), (1, pad_r), (0, 0)))
     hp, wp = xp.shape[1], xp.shape[2]
 
-    kern = functools.partial(_kernel, ho=ho, wo=wo, stride=stride)
+    rows = _pick_rows(ho, wo, c, stride)
+    kern = functools.partial(_kernel, wo=wo, stride=stride, rows=rows)
     return pl.pallas_call(
         kern,
-        grid=(n,),
+        grid=(n, ho // rows),
         in_specs=[
-            pl.BlockSpec((1, hp, wp, c), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((3, 3, c), lambda i: (0, 0, 0)),
+            # Whole padded image per program (same block for every
+            # stripe index — Pallas keeps it resident); the kernel
+            # slices its stripe (+halo) out with pl.ds.
+            pl.BlockSpec((1, hp, wp, c), lambda i, j: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, c), lambda i, j: (0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, ho, wo, c), lambda i: (i, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, rows, wo, c), lambda i, j: (i, j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n, ho, wo, c), x.dtype),
         interpret=interpret,
     )(xp, w)
