@@ -53,6 +53,11 @@ class DataConfig:
     # Synthetic-dataset sizes (CIFAR-10-shaped stand-in for hermetic runs).
     synthetic_train_size: int = 50_000
     synthetic_test_size: int = 10_000
+    # Token datasets (dataset="synthetic_lm", model "lm"): sequence
+    # length and vocab of the generated bigram data. vocab_size must
+    # match ModelConfig.vocab_size (the CLI --vocab-size sets both).
+    seq_len: int = 128
+    vocab_size: int = 256
     # Deviation from torch DistributedSampler (which pads shards to equal
     # length, :119-124): we drop the train remainder and evaluate the test
     # set exactly (padding with masked examples), which also fixes the
@@ -73,7 +78,7 @@ class ModelConfig:
     """Model config (reference model at :137-139: torchvision MobileNetV2
     with the classifier head swapped to 10 classes)."""
 
-    name: str = "mobilenet_v2"        # mobilenet_v2 | vit | vit_{tiny,small,base}
+    name: str = "mobilenet_v2"        # mobilenet_v2 | vit | vit_{tiny,small,base} | vit_pp | lm
     num_classes: int = 10
     width_mult: float = 1.0
     dropout_rate: float = 0.2         # torchvision MobileNetV2 default
@@ -101,6 +106,10 @@ class ModelConfig:
     # Pipeline parallelism (model name "vit_pp"): GPipe microbatches per
     # step; stages = the mesh 'pipe' axis size.
     pp_microbatches: int = 4
+    # LM family (model name "lm"): vocab and the learned-position table
+    # size (max trainable sequence length).
+    vocab_size: int = 256
+    max_seq_len: int = 1024
     # Optional path to a torch state_dict (.pth) with ImageNet-pretrained
     # weights to convert (transfer learning is load-bearing for the ~96%
     # accuracy target — reference README.md:24-26).
@@ -215,12 +224,20 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-dir", default=None)
-    p.add_argument("--dataset", default=None, choices=["cifar10", "synthetic"])
+    p.add_argument("--dataset", default=None,
+                   choices=["cifar10", "synthetic", "synthetic_lm"])
     p.add_argument("--pretrained", default=None,
                    help="path to a torch MobileNetV2 state_dict to convert")
     p.add_argument("--model", default=None,
                    choices=["mobilenet_v2", "vit", "vit_tiny", "vit_small",
-                            "vit_base", "vit_pp"])
+                            "vit_base", "vit_pp", "lm"])
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="sequence length for token datasets (model lm)")
+    p.add_argument("--max-seq-len", type=int, default=None,
+                   help="LM position-table size (defaults to at least "
+                        "--seq-len)")
+    p.add_argument("--vocab-size", type=int, default=None,
+                   help="vocab for the lm model + synthetic_lm data")
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatches per step (vit_pp)")
     p.add_argument("--attention", default=None,
@@ -276,6 +293,17 @@ def config_from_args(argv=None) -> TrainConfig:
         data = dataclasses.replace(data, dataset=args.dataset)
     if args.no_native_loader:
         data = dataclasses.replace(data, native_loader=False)
+    if args.seq_len is not None:
+        data = dataclasses.replace(data, seq_len=args.seq_len)
+    if args.max_seq_len is not None:
+        model = dataclasses.replace(model, max_seq_len=args.max_seq_len)
+    if data.seq_len > model.max_seq_len:
+        # Long-context runs shouldn't require editing source: grow the
+        # position table to cover the requested sequence length.
+        model = dataclasses.replace(model, max_seq_len=data.seq_len)
+    if args.vocab_size is not None:
+        data = dataclasses.replace(data, vocab_size=args.vocab_size)
+        model = dataclasses.replace(model, vocab_size=args.vocab_size)
     if args.synthetic_size is not None:
         data = dataclasses.replace(
             data, synthetic_train_size=args.synthetic_size,

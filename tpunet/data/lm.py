@@ -1,0 +1,50 @@
+"""Synthetic language-modeling data.
+
+The reference has no sequence workload at all (SURVEY.md section 5);
+this generator backs the LM model family's tests and demos in
+no-egress environments. Sequences follow a seeded random bigram
+process: each token has one preferred successor taken with probability
+0.8 (uniform otherwise), so a causal LM has real, learnable structure
+(a perfect model reaches ~0.8 next-token accuracy; a uniform guesser
+1/vocab) while the data stays hermetic and deterministic.
+
+Returned in the Trainer's (train_x, train_y, test_x, test_y)
+convention; for token data the y arrays are per-sequence dummy labels
+(the LM steps derive targets by shifting x — tpunet/train/steps.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from tpunet.config import DataConfig
+
+Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+def synthetic_lm(n_train: int, n_test: int, seq_len: int = 128,
+                 vocab: int = 256, seed: int = 0) -> Arrays:
+    rng = np.random.default_rng(seed)
+    preferred = rng.integers(0, vocab, vocab)
+
+    def gen(n: int) -> np.ndarray:
+        toks = np.empty((n, seq_len), np.int32)
+        toks[:, 0] = rng.integers(0, vocab, n)
+        for t in range(1, seq_len):
+            follow = rng.random(n) < 0.8
+            toks[:, t] = np.where(follow, preferred[toks[:, t - 1]],
+                                  rng.integers(0, vocab, n))
+        return toks
+
+    train_x, test_x = gen(n_train), gen(n_test)
+    return (train_x, np.zeros(n_train, np.int32),
+            test_x, np.zeros(n_test, np.int32))
+
+
+def get_lm_dataset(cfg: DataConfig) -> Arrays:
+    if cfg.dataset != "synthetic_lm":
+        raise ValueError(f"unknown LM dataset {cfg.dataset!r}")
+    return synthetic_lm(cfg.synthetic_train_size, cfg.synthetic_test_size,
+                        seq_len=cfg.seq_len, vocab=cfg.vocab_size)

@@ -27,19 +27,28 @@ def create_model(cfg: ModelConfig, mesh=None):
     if cfg.name == "vit_pp":
         from tpunet.models import vit_pp
         return vit_pp.create_model(cfg, mesh=mesh)
+    if cfg.name == "lm":
+        from tpunet.models import lm
+        return lm.create_model(cfg, mesh=mesh)
     if cfg.name == "vit" or cfg.name in VIT_PRESETS:
         return vit.create_model(cfg, mesh=mesh)
     raise ValueError(f"unknown model {cfg.name!r}")
 
 
 def init_variables(model, rng: jax.Array, image_size: int = 224,
-                   batch_size: int = 1) -> dict:
-    """Initialize model variables with a dummy NHWC batch.
+                   batch_size: int = 1, seq_len: int = 16) -> dict:
+    """Initialize model variables with a dummy batch — NHWC images, or
+    int32 tokens for models declaring ``input_kind = "tokens"``.
 
-    ``batch_size`` matters only for models whose attention runs under
-    shard_map (ring): the init batch must divide the mesh's batch axes.
+    ``batch_size`` (and ``seq_len`` for token models) matters only for
+    models whose attention runs under shard_map (ring): the init batch
+    must divide the mesh's batch/seq axes.
     """
-    dummy = jnp.zeros((batch_size, image_size, image_size, 3), jnp.float32)
+    if getattr(model, "input_kind", "image") == "tokens":
+        dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+    else:
+        dummy = jnp.zeros((batch_size, image_size, image_size, 3),
+                          jnp.float32)
     return model.init({"params": rng}, dummy, train=False)
 
 

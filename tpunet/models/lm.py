@@ -1,0 +1,122 @@
+"""Decoder-only transformer LM — tpunet's long-context model family.
+
+The reference is a fixed-224px vision CNN with no sequence axis at all
+(SURVEY.md section 5, "long-context: absent entirely"); tpunet treats
+long context as first-class, and this model is where it is exercised
+end-to-end: causal attention over sequences whose length scales with
+the mesh 'seq' axis (ring attention, exact causality under sharding via
+global positions) or with bounded memory on one chip (blockwise).
+
+Architecture: token embedding + learned positions -> the same pre-LN
+encoder blocks as the ViT family (tpunet/models/vit.py, with a causal
+attention core) -> final LN -> logits against the embedding transpose
+(weight tying — halves the head params and is standard for small LMs).
+
+Reuses the whole tpunet stack: Trainer epoch loop, psum metrics, Orbax
+checkpointing, TP path rules (the block param names match the ViT
+rules), MoE blocks, and the dense/blockwise/ring attention cores.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpunet.config import ModelConfig
+from tpunet.models.vit import EncoderBlock, make_attn_fn
+
+
+class TransformerLM(nn.Module):
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
+
+    vocab_size: int = 256
+    hidden: int = 192
+    depth: int = 6
+    heads: int = 3
+    mlp_ratio: float = 4.0
+    max_len: int = 1024
+    dropout_rate: float = 0.0
+    attn_fn: Any = None
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    input_kind = "tokens"              # init_variables dispatch
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, t = tokens.shape
+        if t > self.max_len:
+            raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
+        embed = nn.Embed(self.vocab_size, self.hidden,
+                         embedding_init=nn.initializers.normal(stddev=0.02),
+                         param_dtype=self.param_dtype, name="embed")
+        x = embed(tokens).astype(self.dtype)
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
+                         (1, self.max_len, self.hidden), self.param_dtype)
+        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, 1).astype(self.dtype)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.depth):
+            moe_here = (self.moe_experts > 0
+                        and i % self.moe_every == self.moe_every - 1)
+            x = EncoderBlock(self.heads, int(self.hidden * self.mlp_ratio),
+                             attn_fn=self.attn_fn,
+                             moe_experts=self.moe_experts if moe_here else 0,
+                             moe_top_k=self.moe_top_k,
+                             moe_capacity_factor=self.moe_capacity_factor,
+                             dropout_rate=self.dropout_rate,
+                             dtype=self.dtype, param_dtype=self.param_dtype,
+                             name=f"block{i:02d}")(x, train)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln")(x)
+        # Tied output head: logits against the embedding matrix.
+        logits = embed.attend(x.astype(self.param_dtype))
+        return logits.astype(jnp.float32)
+
+
+def create_model(cfg: ModelConfig, mesh=None) -> TransformerLM:
+    return TransformerLM(
+        vocab_size=cfg.vocab_size,
+        hidden=cfg.vit_hidden,
+        depth=cfg.vit_depth,
+        heads=cfg.vit_heads,
+        mlp_ratio=cfg.vit_mlp_ratio,
+        max_len=cfg.max_seq_len,
+        dropout_rate=cfg.dropout_rate,
+        attn_fn=make_attn_fn(cfg, mesh, causal=True),
+        moe_experts=cfg.moe_experts,
+        moe_every=cfg.moe_every,
+        moe_top_k=cfg.moe_top_k,
+        moe_capacity_factor=cfg.moe_capacity_factor,
+        dtype=jnp.dtype(cfg.dtype),
+        param_dtype=jnp.dtype(cfg.param_dtype),
+    )
+
+
+def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
+             *, temperature: float = 0.0, rng=None):
+    """Greedy (or sampled) autoregressive generation from ``prompt``
+    [B, T0] int32. Recomputes the full prefix each step (no KV cache —
+    fine for the demo/test scale; the attention cores themselves are
+    the long-context story)."""
+    tokens = jnp.asarray(prompt, jnp.int32)
+
+    @jax.jit
+    def next_token(tokens, key):
+        logits = model.apply(variables, tokens, train=False)[:, -1]
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, -1)
+        return jnp.argmax(logits, -1)
+
+    keys = jax.random.split(rng if rng is not None else jax.random.PRNGKey(0),
+                            n_new)
+    for i in range(n_new):
+        nxt = next_token(tokens, keys[i])
+        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    return tokens

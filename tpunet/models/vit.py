@@ -198,23 +198,26 @@ VIT_PRESETS = {
 }
 
 
-def make_attn_fn(cfg: ModelConfig, mesh=None) -> AttnFn:
+def make_attn_fn(cfg: ModelConfig, mesh=None, causal: bool = False) -> AttnFn:
     """Resolve the configured attention implementation.
 
     'ring' needs the mesh (sequence-parallel shard_map over its 'seq'
-    axis); 'dense'/'blockwise' are mesh-free.
+    axis); 'dense'/'blockwise' are mesh-free. ``causal`` is exact under
+    sequence sharding (global positions, tpunet/ops/attention.py).
     """
     import functools
     if cfg.attention == "dense":
-        return dense_attention
+        return functools.partial(dense_attention, causal=causal)
     if cfg.attention == "blockwise":
         return functools.partial(blockwise_attention,
-                                 block_size=cfg.attention_block)
+                                 block_size=cfg.attention_block,
+                                 causal=causal)
     if cfg.attention == "ring":
         if mesh is None:
             raise ValueError("attention='ring' requires a mesh")
         from tpunet.ops import ring_self_attention
-        return functools.partial(ring_self_attention, mesh=mesh)
+        return functools.partial(ring_self_attention, mesh=mesh,
+                                 causal=causal)
     raise ValueError(f"unknown attention {cfg.attention!r}")
 
 

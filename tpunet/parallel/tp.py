@@ -66,6 +66,10 @@ def rules_for(cfg: ModelConfig) -> Rules:
         return VIT_PP_RULES
     if cfg.name == "vit" or cfg.name.startswith("vit_"):
         return VIT_TP_RULES
+    if cfg.name == "lm":
+        # The LM reuses the ViT encoder blocks, so the same Megatron
+        # rules apply; embedding/positions stay replicated.
+        return VIT_TP_RULES
     return ()
 
 

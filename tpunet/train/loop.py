@@ -26,7 +26,8 @@ from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
 from tpunet.parallel.tp import rules_for, tree_shardings
 from tpunet.train import metrics as M
 from tpunet.train.state import create_train_state
-from tpunet.train.steps import make_eval_step, make_train_step
+from tpunet.train.steps import (make_eval_step, make_lm_eval_step,
+                                make_lm_train_step, make_train_step)
 from tpunet.utils import Timer, epoch_line, log0
 from tpunet.utils.logging import summary_lines
 from tpunet.utils.prng import root_key, step_key
@@ -44,10 +45,23 @@ class Trainer:
         if self.spe == 0:
             raise ValueError("batch size larger than training set")
 
+        self.is_lm = cfg.model.name == "lm"
+        is_token_data = cfg.data.dataset == "synthetic_lm"
+        if self.is_lm != is_token_data:
+            raise ValueError(
+                f"model {cfg.model.name!r} and dataset "
+                f"{cfg.data.dataset!r} are different families (the 'lm' "
+                "model needs token data, e.g. --dataset synthetic_lm)")
+        if self.is_lm and cfg.model.vocab_size != cfg.data.vocab_size:
+            raise ValueError(
+                f"model vocab {cfg.model.vocab_size} != data vocab "
+                f"{cfg.data.vocab_size}; out-of-range tokens would be "
+                "silently clamped by the embedding")
         state = create_train_state(
             cfg.model, cfg.optim, root_key(cfg.seed),
             image_size=cfg.data.image_size,
-            steps_per_epoch=self.spe, epochs=cfg.epochs, mesh=self.mesh)
+            steps_per_epoch=self.spe, epochs=cfg.epochs, mesh=self.mesh,
+            seq_len=cfg.data.seq_len)
         repl = replicated_sharding(self.mesh)
         bsh = batch_sharding(self.mesh)
         # Tensor parallelism: params (and, via mirrored tree paths, their
@@ -61,17 +75,21 @@ class Trainer:
         # internals (e.g. a 'seq'-sharded pos-embed gradient) onto the
         # returned state, which would then mismatch in_shardings on the
         # next call.
+        train_fn = (make_lm_train_step(cfg.optim, cfg.model) if self.is_lm
+                    else make_train_step(cfg.data, cfg.optim, cfg.model))
+        eval_fn = (make_lm_eval_step() if self.is_lm
+                   else make_eval_step(cfg.data))
         self.train_step = jax.jit(
-            make_train_step(cfg.data, cfg.optim, cfg.model),
+            train_fn,
             in_shardings=(state_sh, bsh, bsh, repl),
             out_shardings=(state_sh, repl),
             donate_argnums=0)
         self.eval_step = jax.jit(
-            make_eval_step(cfg.data),
+            eval_fn,
             in_shardings=(state_sh, bsh, bsh, bsh))
 
         self._prefetcher = None
-        if cfg.data.native_loader:
+        if cfg.data.native_loader and not self.is_lm:
             from tpunet.data import native
             if native.available():
                 local = cfg.data.batch_size // jax.process_count()

@@ -54,7 +54,7 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int,
 def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
                        rng: jax.Array, *, image_size: int,
                        steps_per_epoch: int, epochs: int,
-                       mesh=None) -> TrainState:
+                       mesh=None, seq_len: int = 16) -> TrainState:
     """Build model variables (optionally overlaying converted pretrained
     torch weights, reference :137-139) and the optimizer state.
 
@@ -74,7 +74,7 @@ def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
         elif model_cfg.attention == "ring":
             init_batch = mesh.shape["data"]
     variables = init_variables(model, rng, image_size=image_size,
-                               batch_size=init_batch)
+                               batch_size=init_batch, seq_len=seq_len)
     if model_cfg.pretrained_path:
         if model_cfg.name != "mobilenet_v2":
             raise ValueError(
