@@ -1,0 +1,80 @@
+"""Preemption guard, graceful mid-run checkpoint, and metrics.jsonl."""
+
+import json
+import os
+import signal
+
+import numpy as np
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, OptimConfig, TrainConfig)
+from tpunet.train.loop import Trainer
+from tpunet.utils.preemption import PreemptionGuard
+
+
+def test_guard_catches_signal_and_restores_handler():
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,))
+    before = signal.getsignal(signal.SIGUSR1)
+    with guard:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert guard.requested
+    assert signal.getsignal(signal.SIGUSR1) == before
+
+
+def _cfg(tmp_path, epochs=3):
+    return TrainConfig(
+        epochs=epochs,
+        data=DataConfig(dataset="synthetic", image_size=32, batch_size=32,
+                        synthetic_train_size=64, synthetic_test_size=32),
+        model=ModelConfig(name="vit", vit_patch=4, vit_hidden=32,
+                          vit_depth=1, vit_heads=2, dropout_rate=0.0,
+                          dtype="float32"),
+        optim=OptimConfig(),
+        mesh=MeshConfig(data=2),
+        checkpoint=CheckpointConfig(directory=str(tmp_path), keep=3),
+    )
+
+
+def test_preempted_run_saves_state_and_resumes(tmp_path):
+    trainer = Trainer(_cfg(tmp_path))
+    real_epoch = trainer.train_one_epoch
+
+    def epoch_then_preempt(epoch):
+        m = real_epoch(epoch)
+        trainer.guard.request()   # same path as SIGTERM
+        return m
+
+    trainer.train_one_epoch = epoch_then_preempt
+    try:
+        history = trainer.train()
+    finally:
+        trainer.close()
+    assert history == []          # preempted epoch logs no record
+    step_after_one_epoch = trainer.global_step
+    assert step_after_one_epoch == 2  # 64 / 32
+
+    resumed = Trainer(_cfg(tmp_path).replace(
+        checkpoint=CheckpointConfig(directory=str(tmp_path), resume=True,
+                                    keep=3)))
+    try:
+        assert resumed.start_epoch == 2
+        assert resumed.global_step == step_after_one_epoch
+        m = resumed.train_one_epoch(2)
+    finally:
+        resumed.close()
+    assert np.isfinite(m["loss"])
+
+
+def test_metrics_jsonl_written(tmp_path):
+    trainer = Trainer(_cfg(tmp_path, epochs=2))
+    try:
+        trainer.train()
+    finally:
+        trainer.close()
+    path = os.path.join(str(tmp_path), "metrics.jsonl")
+    with open(path) as f:
+        records = [json.loads(line) for line in f]
+    assert [r["epoch"] for r in records] == [1, 2]
+    for r in records:
+        assert {"seconds", "step", "train_loss", "test_accuracy"} <= set(r)

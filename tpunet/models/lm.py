@@ -102,21 +102,31 @@ def create_model(cfg: ModelConfig, mesh=None) -> TransformerLM:
 def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
              *, temperature: float = 0.0, rng=None):
     """Greedy (or sampled) autoregressive generation from ``prompt``
-    [B, T0] int32. Recomputes the full prefix each step (no KV cache —
+    [B, T0] int32. Works on a fixed [B, T0+n_new] buffer so the jitted
+    step compiles ONCE (a growing array would recompile every token);
+    causality makes the not-yet-written future positions irrelevant to
+    the sampled logit. Recomputes the prefix each step (no KV cache —
     fine for the demo/test scale; the attention cores themselves are
     the long-context story)."""
-    tokens = jnp.asarray(prompt, jnp.int32)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t0 = prompt.shape
+    buf = jnp.zeros((b, t0 + n_new), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
 
     @jax.jit
-    def next_token(tokens, key):
-        logits = model.apply(variables, tokens, train=False)[:, -1]
+    def write_next(buf, cur, key):
+        logits = model.apply(variables, buf, train=False)
+        lg = jax.lax.dynamic_index_in_dim(logits, cur - 1, axis=1,
+                                          keepdims=False)
         if temperature > 0:
-            return jax.random.categorical(key, logits / temperature, -1)
-        return jnp.argmax(logits, -1)
+            nxt = jax.random.categorical(key, lg / temperature, -1)
+        else:
+            nxt = jnp.argmax(lg, -1)
+        return jax.lax.dynamic_update_slice(
+            buf, nxt[:, None].astype(jnp.int32), (0, cur))
 
     keys = jax.random.split(rng if rng is not None else jax.random.PRNGKey(0),
                             n_new)
     for i in range(n_new):
-        nxt = next_token(tokens, keys[i])
-        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
-    return tokens
+        buf = write_next(buf, jnp.int32(t0 + i), keys[i])
+    return buf

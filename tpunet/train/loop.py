@@ -29,7 +29,8 @@ from tpunet.train.state import create_train_state
 from tpunet.train.steps import (make_eval_step, make_lm_eval_step,
                                 make_lm_train_step, make_train_step)
 from tpunet.utils import Timer, epoch_line, log0
-from tpunet.utils.logging import summary_lines
+from tpunet.utils.logging import MetricsLogger, summary_lines
+from tpunet.utils.preemption import PreemptionGuard
 from tpunet.utils.prng import root_key, step_key
 
 
@@ -97,6 +98,7 @@ class Trainer:
                     self.train_x, self.train_y.astype(np.int32), local)
 
         self.ckpt = Checkpointer(cfg.checkpoint)
+        self.guard = PreemptionGuard()
         self.global_step = 0
         self.start_epoch = 1
         self.best_acc = 0.0
@@ -144,10 +146,29 @@ class Trainer:
             process_index=jax.process_index(),
             process_count=jax.process_count())
 
+    def _stop_agreed(self) -> bool:
+        """Cross-host-agreed preemption decision. The signal flag is
+        process-local; if hosts diverged on it, the ones still issuing
+        the sharded train step would deadlock in its collectives and the
+        multi-host Orbax save would wedge. Every host calls the same
+        broadcast each step and adopts the coordinator's flag."""
+        if jax.process_count() == 1:
+            return self.guard.requested
+        from jax.experimental import multihost_utils
+        import jax.numpy as jnp
+        agreed = multihost_utils.broadcast_one_to_all(
+            jnp.asarray(self.guard.requested))
+        stop = bool(agreed)
+        if stop:
+            self.guard.request()  # keep local flag consistent for train()
+        return stop
+
     def train_one_epoch(self, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
         acc = None
         for bx, by in self._epoch_batches(epoch):
+            if self._stop_agreed():
+                break  # preemption: stop at a step boundary
             rng = step_key(cfg.seed, self.global_step)
             gx, gy = shard_host_batch(self.mesh, bx, by.astype(np.int32))
             self.state, m = self.train_step(self.state, gx, gy, rng)
@@ -181,31 +202,51 @@ class Trainer:
                                 if self._prefetcher is not None else "numpy"))
         log0("Starting training...")
         log0("")
+        metrics_log = MetricsLogger(cfg.checkpoint.directory)
         total = Timer()
-        for epoch in range(self.start_epoch, cfg.epochs + 1):
-            timer = Timer()
-            train_m = self.train_one_epoch(epoch)
-            test_m = self.evaluate()
-            secs = timer.elapsed()
-            log0(epoch_line(epoch, cfg.epochs, secs,
-                            train_m["loss"], train_m["accuracy"],
-                            test_m["loss"], test_m["accuracy"]))
-            record = {
-                "epoch": epoch, "seconds": secs,
-                "train_loss": train_m["loss"],
-                "train_accuracy": train_m["accuracy"],
-                "test_loss": test_m["loss"],
-                "test_accuracy": test_m["accuracy"],
-            }
-            self.history.append(record)
-            if test_m["accuracy"] > self.best_acc:
-                self.best_acc = test_m["accuracy"]
-                self.ckpt.save_best({
-                    "params": self.state.params,
-                    "batch_stats": self.state.batch_stats,
-                })
-            self.start_epoch = epoch
-            self.ckpt.save_state(epoch, self._payload())
+        self.guard.install()
+        try:
+            for epoch in range(self.start_epoch, cfg.epochs + 1):
+                timer = Timer()
+                train_m = self.train_one_epoch(epoch)
+                if self.guard.requested:
+                    # Preempted mid-epoch: persist the advanced state
+                    # (step counter keeps the LR schedule exact) and
+                    # leave; --resume continues from the next epoch.
+                    if cfg.checkpoint.save_last:
+                        log0(f"Preemption requested; saving state at epoch "
+                             f"{epoch} (step {self.global_step}) and exiting")
+                        self.start_epoch = epoch
+                        self.ckpt.save_state(epoch, self._payload())
+                    else:
+                        log0("Preemption requested; state NOT saved "
+                             "(checkpoint.save_last is off) — exiting")
+                    break
+                test_m = self.evaluate()
+                secs = timer.elapsed()
+                log0(epoch_line(epoch, cfg.epochs, secs,
+                                train_m["loss"], train_m["accuracy"],
+                                test_m["loss"], test_m["accuracy"]))
+                record = {
+                    "epoch": epoch, "seconds": secs,
+                    "step": self.global_step,
+                    "train_loss": train_m["loss"],
+                    "train_accuracy": train_m["accuracy"],
+                    "test_loss": test_m["loss"],
+                    "test_accuracy": test_m["accuracy"],
+                }
+                self.history.append(record)
+                metrics_log.log(record)
+                if test_m["accuracy"] > self.best_acc:
+                    self.best_acc = test_m["accuracy"]
+                    self.ckpt.save_best({
+                        "params": self.state.params,
+                        "batch_stats": self.state.batch_stats,
+                    })
+                self.start_epoch = epoch
+                self.ckpt.save_state(epoch, self._payload())
+        finally:
+            self.guard.uninstall()
         log0("")
         for line in summary_lines(self.best_acc, total.elapsed()):
             log0(line)

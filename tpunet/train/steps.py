@@ -24,6 +24,24 @@ from tpunet.train import metrics as M
 from tpunet.train.state import TrainState
 
 
+def _ce_loss(logits, targets, smoothing: float):
+    """Per-example/token CE, optionally label-smoothed (StepLR stack's
+    CrossEntropyLoss analogue; shared by the image and LM steps)."""
+    if smoothing > 0:
+        return optax.softmax_cross_entropy(
+            logits, optax.smooth_labels(
+                jax.nn.one_hot(targets, logits.shape[-1]), smoothing))
+    return optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+
+
+def _with_aux(loss, mutated, aux_weight: float):
+    """Add weighted MoE load-balance terms sown into 'losses'."""
+    aux_terms = jax.tree_util.tree_leaves(mutated.get("losses", {}))
+    if aux_terms and aux_weight > 0:
+        loss = loss + aux_weight * sum(aux_terms)
+    return loss
+
+
 def make_train_step(data_cfg: DataConfig,
                     optim_cfg: OptimConfig,
                     model_cfg: Optional[ModelConfig] = None) -> Callable:
@@ -49,17 +67,8 @@ def make_train_step(data_cfg: DataConfig,
                 images, train=True,
                 rngs={"dropout": dropout_rng},
                 mutable=["batch_stats", "losses"])
-            if smoothing > 0:
-                losses = optax.softmax_cross_entropy(
-                    logits, optax.smooth_labels(
-                        jax.nn.one_hot(labels, logits.shape[-1]), smoothing))
-            else:
-                losses = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, labels)
-            loss = losses.mean()
-            aux_terms = jax.tree_util.tree_leaves(mutated.get("losses", {}))
-            if aux_terms and aux_weight > 0:
-                loss = loss + aux_weight * sum(aux_terms)
+            loss = _with_aux(_ce_loss(logits, labels, smoothing).mean(),
+                             mutated, aux_weight)
             return loss, (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
@@ -89,17 +98,8 @@ def make_lm_train_step(optim_cfg: OptimConfig,
                 rngs={"dropout": rng},
                 mutable=["batch_stats", "losses"])
             lg, tgt = logits[:, :-1], tokens[:, 1:]
-            if smoothing > 0:
-                losses = optax.softmax_cross_entropy(
-                    lg, optax.smooth_labels(
-                        jax.nn.one_hot(tgt, lg.shape[-1]), smoothing))
-            else:
-                losses = optax.softmax_cross_entropy_with_integer_labels(
-                    lg, tgt)
-            loss = losses.mean()
-            aux_terms = jax.tree_util.tree_leaves(mutated.get("losses", {}))
-            if aux_terms and aux_weight > 0:
-                loss = loss + aux_weight * sum(aux_terms)
+            loss = _with_aux(_ce_loss(lg, tgt, smoothing).mean(),
+                             mutated, aux_weight)
             return loss, (lg, tgt, mutated.get("batch_stats", {}))
 
         (loss, (lg, tgt, new_stats)), grads = jax.value_and_grad(

@@ -47,3 +47,25 @@ def summary_lines(best_acc: float, total_seconds: float) -> list[str]:
         f"Best test accuracy: {best_acc:.4f}",
         f"Total training time: {total_seconds:.2f}s ({total_seconds / 60:.2f} min)",
     ]
+
+
+class MetricsLogger:
+    """Machine-readable observability: one JSON line per epoch, appended
+    to ``<dir>/metrics.jsonl`` by the coordinator process. The reference
+    persists metrics only as SLURM stdout redirection of the epoch lines
+    (cifar10_gpu_parallel.sh:8-9); this is the structured upgrade —
+    append-mode + per-line flush keeps it crash/preemption-safe."""
+
+    def __init__(self, directory: str):
+        import os
+        self._path = None
+        if is_coordinator():
+            os.makedirs(directory, exist_ok=True)
+            self._path = os.path.join(directory, "metrics.jsonl")
+
+    def log(self, record: dict) -> None:
+        if self._path is None:
+            return
+        import json
+        with open(self._path, "a") as f:
+            f.write(json.dumps(record) + "\n")

@@ -1,0 +1,56 @@
+"""Graceful-preemption handling.
+
+The reference has no failure story at all — a SLURM walltime kill or
+node preemption loses everything since the epoch-0 restart is the only
+path (SURVEY.md section 5). TPU-VM spot/preemptible instances send
+SIGTERM with a short grace window; this guard turns that into a clean
+stop: the signal sets a flag, the Trainer notices it between steps,
+saves a full-state checkpoint and exits, and ``--resume`` continues.
+
+The handler only sets a flag (async-signal-safe); all real work happens
+on the main thread at a step boundary. Previous handlers are chained so
+embedding tpunet in a larger program keeps its signal behavior.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Iterable, Optional
+
+
+class PreemptionGuard:
+    """Install with ``install()``; poll ``requested`` between steps."""
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._previous: Optional[dict] = None
+        self.requested = False
+
+    def _handler(self, signum, frame):
+        self.requested = True
+        prev = (self._previous or {}).get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    def request(self) -> None:
+        """Programmatic stop request (same path as a signal)."""
+        self.requested = True
+
+    def install(self) -> "PreemptionGuard":
+        if self._previous is None:
+            self._previous = {}
+            for s in self._signals:
+                self._previous[s] = signal.signal(s, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        if self._previous is not None:
+            for s, prev in self._previous.items():
+                signal.signal(s, prev if prev is not None else signal.SIG_DFL)
+            self._previous = None
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
