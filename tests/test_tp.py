@@ -84,6 +84,43 @@ def test_tp_training_parity():
     assert abs(base_e["accuracy"] - tp_e["accuracy"]) < 1e-6
 
 
+def test_zero1_shards_moments_and_keeps_parity():
+    """ZeRO-1: Adam moments shard over 'data', params stay replicated,
+    training math unchanged."""
+    base_t, _ = _one_epoch(MeshConfig(data=4))
+
+    mesh = make_mesh(MeshConfig(data=4, zero1=True))
+    trainer = Trainer(_cfg(MeshConfig(data=4, zero1=True)), mesh=mesh)
+    try:
+        z_t = trainer.train_one_epoch(1)
+        mu = trainer.state.opt_state[0].mu
+        # big kernels shard their leading dim; params stay replicated
+        assert mu["block00"]["attn"]["qkv"]["kernel"].sharding.spec \
+            == P("data")
+        assert trainer.state.params["block00"]["attn"]["qkv"]["kernel"] \
+            .sharding.spec == P()
+        # leading dim 1 (pos_embed) is indivisible -> replicated
+        assert mu["pos_embed"].sharding.spec == P()
+    finally:
+        trainer.close()
+    assert abs(base_t["loss"] - z_t["loss"]) < 1e-4
+
+
+def test_zero1_composes_with_tp():
+    """With model>1 the TP rules win for matched moments; ZeRO-1 takes
+    the rest."""
+    mesh = make_mesh(MeshConfig(data=2, model=2, zero1=True))
+    trainer = Trainer(_cfg(MeshConfig(data=2, model=2, zero1=True)),
+                      mesh=mesh)
+    try:
+        mu = trainer.state.opt_state[0].mu
+        assert mu["block00"]["attn"]["qkv"]["kernel"].sharding.spec \
+            == P(None, "model")
+        assert mu["block00"]["ln1"]["scale"].sharding.spec == P("data")
+    finally:
+        trainer.close()
+
+
 def test_dp_sp_tp_combined_training_parity():
     """The flagship composition: data=2 x seq=2 x model=2 over 8 devices,
     ring attention + Megatron-style param sharding, exact same math as

@@ -110,6 +110,11 @@ class ModelConfig:
     # size (max trainable sequence length).
     vocab_size: int = 256
     max_seq_len: int = 1024
+    # Rematerialize encoder blocks (jax.checkpoint): recompute block
+    # activations in the backward pass instead of storing them — trades
+    # ~1/3 more FLOPs for O(depth) less activation memory, the standard
+    # lever for long-context training (ViT and LM families).
+    remat: bool = False
     # Optional path to a torch state_dict (.pth) with ImageNet-pretrained
     # weights to convert (transfer learning is load-bearing for the ~96%
     # accuracy target — reference README.md:24-26).
@@ -151,6 +156,9 @@ class MeshConfig:
     seq: int = 1                      # sequence/context-parallel axis
     pipe: int = 1                     # pipeline-parallel axis (GPipe)
     model: int = 1                    # tensor-parallel axis
+    # ZeRO-1: shard Adam moments over 'data' (params stay replicated,
+    # exactly the reference's layout); GSPMD gathers as needed.
+    zero1: bool = False
 
     def shape(self, n_devices: int) -> Tuple[int, int, int, int]:
         seq = max(1, self.seq)
@@ -246,6 +254,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "sequence-parallel over the mesh 'seq' axis")
     p.add_argument("--attention-block", type=int, default=None,
                    help="K/V chunk size for --attention blockwise")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize encoder blocks (less activation "
+                        "memory, ~1/3 more backward FLOPs)")
+    p.add_argument("--zero1", action="store_true",
+                   help="shard optimizer moments over the 'data' axis "
+                        "(ZeRO-1); params stay replicated")
     p.add_argument("--moe-experts", type=int, default=None,
                    help="experts per MoE block (ViT); 0 = dense MLPs")
     p.add_argument("--moe-top-k", type=int, default=None)
@@ -316,6 +330,10 @@ def config_from_args(argv=None) -> TrainConfig:
         model = dataclasses.replace(model, attention=args.attention)
     if args.attention_block is not None:
         model = dataclasses.replace(model, attention_block=args.attention_block)
+    if args.remat:
+        model = dataclasses.replace(model, remat=True)
+    if args.zero1:
+        mesh = dataclasses.replace(mesh, zero1=True)
     for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads",
                  "moe_experts", "moe_top_k", "moe_every",
                  "moe_capacity_factor", "moe_aux_weight",

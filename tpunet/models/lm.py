@@ -44,6 +44,7 @@ class TransformerLM(nn.Module):
     moe_every: int = 2
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    remat: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -62,10 +63,12 @@ class TransformerLM(nn.Module):
                          (1, self.max_len, self.hidden), self.param_dtype)
         x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, 1).astype(self.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        Block = (nn.remat(EncoderBlock, static_argnums=(2,))
+                 if self.remat else EncoderBlock)
         for i in range(self.depth):
             moe_here = (self.moe_experts > 0
                         and i % self.moe_every == self.moe_every - 1)
-            x = EncoderBlock(self.heads, int(self.hidden * self.mlp_ratio),
+            x = Block(self.heads, int(self.hidden * self.mlp_ratio),
                              attn_fn=self.attn_fn,
                              moe_experts=self.moe_experts if moe_here else 0,
                              moe_top_k=self.moe_top_k,
@@ -94,6 +97,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> TransformerLM:
         moe_every=cfg.moe_every,
         moe_top_k=cfg.moe_top_k,
         moe_capacity_factor=cfg.moe_capacity_factor,
+        remat=cfg.remat,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),
     )
@@ -102,14 +106,35 @@ def create_model(cfg: ModelConfig, mesh=None) -> TransformerLM:
 def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
              *, temperature: float = 0.0, rng=None):
     """Greedy (or sampled) autoregressive generation from ``prompt``
-    [B, T0] int32. Works on a fixed [B, T0+n_new] buffer so the jitted
-    step compiles ONCE (a growing array would recompile every token);
+    [B, T0] int32.
+
+    Dense models run on a fixed [B, T0+n_new] buffer so the jitted step
+    compiles ONCE (a growing array would recompile every token) —
     causality makes the not-yet-written future positions irrelevant to
-    the sampled logit. Recomputes the prefix each step (no KV cache —
-    fine for the demo/test scale; the attention cores themselves are
-    the long-context story)."""
+    the sampled logit. MoE models must instead grow the prefix (one
+    compile per length): capacity-bounded routing couples tokens, so
+    buffer padding would consume expert capacity and change real
+    tokens' logits. Recomputes the prefix each step (no KV cache — fine
+    for the demo/test scale; the attention cores themselves are the
+    long-context story)."""
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t0 = prompt.shape
+    keys = jax.random.split(rng if rng is not None else jax.random.PRNGKey(0),
+                            n_new)
+
+    def pick(lg, key):
+        if temperature > 0:
+            return jax.random.categorical(key, lg / temperature, -1)
+        return jnp.argmax(lg, -1)
+
+    if model.moe_experts > 0:
+        tokens = prompt
+        for i in range(n_new):
+            lg = model.apply(variables, tokens, train=False)[:, -1]
+            nxt = pick(lg, keys[i]).astype(jnp.int32)
+            tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+        return tokens
+
     buf = jnp.zeros((b, t0 + n_new), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
 
@@ -118,15 +143,10 @@ def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
         logits = model.apply(variables, buf, train=False)
         lg = jax.lax.dynamic_index_in_dim(logits, cur - 1, axis=1,
                                           keepdims=False)
-        if temperature > 0:
-            nxt = jax.random.categorical(key, lg / temperature, -1)
-        else:
-            nxt = jnp.argmax(lg, -1)
+        nxt = pick(lg, key)
         return jax.lax.dynamic_update_slice(
             buf, nxt[:, None].astype(jnp.int32), (0, cur))
 
-    keys = jax.random.split(rng if rng is not None else jax.random.PRNGKey(0),
-                            n_new)
     for i in range(n_new):
         buf = write_next(buf, jnp.int32(t0 + i), keys[i])
     return buf

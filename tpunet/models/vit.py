@@ -144,6 +144,7 @@ class ViT(nn.Module):
     moe_every: int = 2                # MoE in every moe_every-th block
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    remat: bool = False               # jax.checkpoint each block
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -166,12 +167,16 @@ class ViT(nn.Module):
                          (1, h * w, c), self.param_dtype)
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # Remat: recompute each block's activations in the backward pass
+        # (jax.checkpoint) — O(depth) less live memory for long contexts.
+        Block = (nn.remat(EncoderBlock, static_argnums=(2,))
+                 if self.remat else EncoderBlock)
         for i in range(self.depth):
             # ViT-MoE placement: sparse MLP in every moe_every-th block
             # (the later block of each pair), dense elsewhere.
             moe_here = (self.moe_experts > 0
                         and i % self.moe_every == self.moe_every - 1)
-            x = EncoderBlock(self.heads, int(self.hidden * self.mlp_ratio),
+            x = Block(self.heads, int(self.hidden * self.mlp_ratio),
                              attn_fn=self.attn_fn,
                              moe_experts=self.moe_experts if moe_here else 0,
                              moe_top_k=self.moe_top_k,
@@ -242,6 +247,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> ViT:
         moe_every=cfg.moe_every,
         moe_top_k=cfg.moe_top_k,
         moe_capacity_factor=cfg.moe_capacity_factor,
+        remat=cfg.remat,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),
     )

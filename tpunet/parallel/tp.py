@@ -58,19 +58,43 @@ VIT_PP_RULES: Rules = (
 )
 
 
-def rules_for(cfg: ModelConfig) -> Rules:
-    """TP rules for the configured model. MobileNetV2 stays replicated —
-    at 2.2M params a CNN gains nothing from weight sharding (the
-    reference's replicated layout is already right for it)."""
+# ZeRO-1: Adam moments shard their leading dim over 'data'; params stay
+# replicated (the reference's layout). Listed AFTER the model rules, so
+# TP/PP-matched moments keep their parameter's sharding and only the
+# rest (embeddings, norms, biases, conv kernels with a divisible lead
+# dim) spread over the data axis.
+ZERO1_RULES: Rules = (
+    (r"(^|/)(mu|nu)/", P("data")),
+)
+
+
+def rules_for(cfg: ModelConfig, mesh: Mesh = None,
+              zero1: bool = False) -> Rules:
+    """Sharding rules for the configured model. MobileNetV2 params stay
+    replicated — at 2.2M params a CNN gains nothing from weight sharding
+    (the reference's replicated layout is already right for it).
+
+    ``mesh`` prunes rules whose axes have size 1 (no-op shardings would
+    otherwise shadow the ZeRO-1 catch-all for those leaves); ``zero1``
+    appends ZERO1_RULES.
+    """
     if cfg.name == "vit_pp":
-        return VIT_PP_RULES
-    if cfg.name == "vit" or cfg.name.startswith("vit_"):
-        return VIT_TP_RULES
-    if cfg.name == "lm":
+        rules = VIT_PP_RULES
+    elif (cfg.name == "vit" or cfg.name.startswith("vit_")
+          or cfg.name == "lm"):
         # The LM reuses the ViT encoder blocks, so the same Megatron
         # rules apply; embedding/positions stay replicated.
-        return VIT_TP_RULES
-    return ()
+        rules = VIT_TP_RULES
+    else:
+        rules = ()
+    if mesh is not None:
+        rules = tuple(
+            (rx, spec) for rx, spec in rules
+            if all(mesh.shape.get(ax, 1) > 1
+                   for ax in spec if ax is not None))
+    if zero1:
+        rules = tuple(rules) + ZERO1_RULES
+    return rules
 
 
 def _path_str(path) -> str:

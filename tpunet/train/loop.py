@@ -69,7 +69,9 @@ class Trainer:
         # Adam moments) matching the model's TP path rules are sharded
         # over the 'model' mesh axis; everything else is replicated, which
         # is exactly the reference's DDP layout (README:77).
-        state_sh = tree_shardings(state, self.mesh, rules_for(cfg.model))
+        state_sh = tree_shardings(
+            state, self.mesh,
+            rules_for(cfg.model, mesh=self.mesh, zero1=cfg.mesh.zero1))
         self.state = jax.device_put(state, state_sh)
 
         # out_shardings pinned: without it XLA may propagate shard_map
@@ -108,10 +110,15 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
-    def _payload(self) -> Dict:
+    def _payload(self, completed: bool = True) -> Dict:
         return {
             "state": self.state,
             "epoch": np.asarray(self.start_epoch, np.int32),
+            # 0 marks a mid-epoch (preemption) save: resume re-runs that
+            # epoch instead of skipping its remaining data (at-least-once
+            # semantics; the restored step counter keeps the LR schedule
+            # continuous either way).
+            "completed": np.asarray(int(completed), np.int32),
             "global_step": np.asarray(self.global_step, np.int32),
             "best_acc": np.asarray(self.best_acc, np.float32),
         }
@@ -121,10 +128,12 @@ class Trainer:
         if restored is None:
             return
         self.state = restored["state"]
-        self.start_epoch = int(restored["epoch"]) + 1
+        completed = int(restored.get("completed", 1))
+        self.start_epoch = int(restored["epoch"]) + (1 if completed else 0)
         self.global_step = int(restored["global_step"])
         self.best_acc = float(restored["best_acc"])
-        log0(f"Resumed from epoch {self.start_epoch - 1} "
+        log0(f"Resumed from epoch {int(restored['epoch'])}"
+             f"{'' if completed else ' (partial)'} "
              f"(best acc {self.best_acc:.4f})")
 
     # ------------------------------------------------------------------
@@ -146,19 +155,29 @@ class Trainer:
             process_index=jax.process_index(),
             process_count=jax.process_count())
 
+    # Multi-host preemption polling period (steps). The agreement
+    # collective blocks the host, so it runs every K steps, in lockstep
+    # on all hosts; a preemption grace window is tens of seconds, far
+    # longer than K steps.
+    STOP_POLL_STEPS = 16
+
     def _stop_agreed(self) -> bool:
         """Cross-host-agreed preemption decision. The signal flag is
         process-local; if hosts diverged on it, the ones still issuing
         the sharded train step would deadlock in its collectives and the
-        multi-host Orbax save would wedge. Every host calls the same
-        broadcast each step and adopts the coordinator's flag."""
+        multi-host Orbax save would wedge. All hosts allgather their
+        flags in lockstep (every STOP_POLL_STEPS steps) and stop if ANY
+        host was signalled — per-VM spot preemption hits workers too,
+        not just the coordinator."""
         if jax.process_count() == 1:
             return self.guard.requested
+        if self.global_step % self.STOP_POLL_STEPS:
+            return False
         from jax.experimental import multihost_utils
         import jax.numpy as jnp
-        agreed = multihost_utils.broadcast_one_to_all(
+        flags = multihost_utils.process_allgather(
             jnp.asarray(self.guard.requested))
-        stop = bool(agreed)
+        stop = bool(np.asarray(flags).any())
         if stop:
             self.guard.request()  # keep local flag consistent for train()
         return stop
@@ -202,7 +221,8 @@ class Trainer:
                                 if self._prefetcher is not None else "numpy"))
         log0("Starting training...")
         log0("")
-        metrics_log = MetricsLogger(cfg.checkpoint.directory)
+        metrics_log = MetricsLogger(cfg.checkpoint.directory,
+                                    resume=cfg.checkpoint.resume)
         total = Timer()
         self.guard.install()
         try:
@@ -210,17 +230,18 @@ class Trainer:
                 timer = Timer()
                 train_m = self.train_one_epoch(epoch)
                 if self.guard.requested:
-                    # Preempted mid-epoch: persist the advanced state
-                    # (step counter keeps the LR schedule exact) and
-                    # leave; --resume continues from the next epoch.
-                    if cfg.checkpoint.save_last:
-                        log0(f"Preemption requested; saving state at epoch "
-                             f"{epoch} (step {self.global_step}) and exiting")
-                        self.start_epoch = epoch
-                        self.ckpt.save_state(epoch, self._payload())
-                    else:
-                        log0("Preemption requested; state NOT saved "
-                             "(checkpoint.save_last is off) — exiting")
+                    # Preempted mid-epoch: persist the advanced state,
+                    # marked partial so --resume re-runs this epoch's
+                    # remaining data instead of skipping it.
+                    log0(f"Preemption requested at epoch {epoch} (step "
+                         f"{self.global_step}); "
+                         + ("saving state and exiting"
+                            if cfg.checkpoint.save_last else
+                            "state NOT saved (checkpoint.save_last is "
+                            "off) — exiting"))
+                    self.start_epoch = epoch
+                    self.ckpt.save_state(epoch,
+                                         self._payload(completed=False))
                     break
                 test_m = self.evaluate()
                 secs = timer.elapsed()

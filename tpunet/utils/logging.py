@@ -56,12 +56,16 @@ class MetricsLogger:
     (cifar10_gpu_parallel.sh:8-9); this is the structured upgrade —
     append-mode + per-line flush keeps it crash/preemption-safe."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, resume: bool = False):
         import os
         self._path = None
         if is_coordinator():
             os.makedirs(directory, exist_ok=True)
             self._path = os.path.join(directory, "metrics.jsonl")
+            if not resume and os.path.exists(self._path):
+                # Fresh run into a reused directory: truncate so the
+                # epoch sequence in the file belongs to one run.
+                open(self._path, "w").close()
 
     def log(self, record: dict) -> None:
         if self._path is None:
