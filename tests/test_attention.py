@@ -14,7 +14,8 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpunet.ops import (blockwise_attention, dense_attention,
-                        ring_attention, ring_self_attention)
+                        ring_attention, ring_self_attention,
+                        ulysses_self_attention)
 
 B, T, H, D = 2, 32, 4, 8
 
@@ -116,6 +117,45 @@ def test_ring_gradients_match_dense(causal):
     for gr, gd in zip(g_ring, g_dense):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
                                    rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(causal):
+    mesh = _seq_mesh()  # seq=4; H=4 heads divisible
+    q, k, v = _qkv(8)
+    out = ulysses_self_attention(q, k, v, mesh, causal=causal)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_gradients_match_dense(causal):
+    mesh = _seq_mesh()
+    q, k, v = _qkv(9)
+
+    def loss_u(q, k, v):
+        return jnp.sum(ulysses_self_attention(q, k, v, mesh,
+                                              causal=causal) ** 2)
+
+    def loss_d(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    devs = np.asarray(jax.devices()[:3]).reshape(1, 3)
+    mesh = Mesh(devs, ("data", "seq"))
+    rng = np.random.default_rng(0)
+    # T=6 divisible by 3, H=4 not divisible by 3
+    q = jnp.asarray(rng.normal(size=(2, 6, 4, 8)), jnp.float32)
+    with pytest.raises(ValueError):
+        ulysses_self_attention(q, q, q, mesh)
 
 
 def test_ring_single_device_axis():

@@ -93,6 +93,22 @@ def test_lm_ring_attention_parity():
     assert abs(base_m["accuracy"] - ring_m["accuracy"]) < 1e-6
 
 
+def test_lm_ulysses_attention_parity():
+    base = Trainer(_cfg(MeshConfig(data=2), epochs=1))
+    try:
+        base_m = base.train_one_epoch(1)
+    finally:
+        base.close()
+    uly = Trainer(_cfg(MeshConfig(data=2, seq=4), epochs=1,
+                       attention="ulysses"))
+    try:
+        uly_m = uly.train_one_epoch(1)
+    finally:
+        uly.close()
+    assert abs(base_m["loss"] - uly_m["loss"]) < 1e-4
+    assert abs(base_m["accuracy"] - uly_m["accuracy"]) < 1e-6
+
+
 def test_lm_blockwise_long_sequence():
     cfg = _cfg(MeshConfig(data=2), epochs=1, attention="blockwise")
     cfg = cfg.replace(model=dataclasses.replace(cfg.model,

@@ -93,7 +93,8 @@ class ModelConfig:
     vit_mlp_ratio: float = 4.0
     # Core attention implementation for attention models:
     # dense | blockwise (chunked K/V, bounded memory) | ring
-    # (sequence-parallel over the mesh 'seq' axis).
+    # (sequence-parallel K/V rotation over the mesh 'seq' axis) |
+    # ulysses (sequence-parallel via two all-to-alls, heads resharded).
     attention: str = "dense"
     attention_block: int = 512        # K/V chunk for attention="blockwise"
     # Mixture-of-Experts (ViT family): 0 experts = dense MLPs. Experts
@@ -249,9 +250,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatches per step (vit_pp)")
     p.add_argument("--attention", default=None,
-                   choices=["dense", "blockwise", "ring"],
-                   help="core attention impl for ViT models; 'ring' is "
-                        "sequence-parallel over the mesh 'seq' axis")
+                   choices=["dense", "blockwise", "ring", "ulysses"],
+                   help="core attention impl for ViT/LM models; 'ring' "
+                        "and 'ulysses' are sequence-parallel over the "
+                        "mesh 'seq' axis")
     p.add_argument("--attention-block", type=int, default=None,
                    help="K/V chunk size for --attention blockwise")
     p.add_argument("--remat", action="store_true",
@@ -277,7 +279,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--mesh-data", type=int, default=None)
     p.add_argument("--mesh-seq", type=int, default=None,
-                   help="sequence-parallel axis size (ring attention)")
+                   help="sequence-parallel axis size (ring/ulysses "
+                        "attention)")
     p.add_argument("--mesh-pipe", type=int, default=None,
                    help="pipeline-parallel axis size (vit_pp model)")
     p.add_argument("--mesh-model", type=int, default=None,

@@ -46,9 +46,9 @@ class Predictor:
                  checkpoint_dir: Optional[str] = None,
                  class_names: Sequence[str] = CIFAR10_CLASSES):
         self.model_cfg = model_cfg or ModelConfig()
-        if self.model_cfg.attention == "ring":
-            # Serving is single-chip; ring attention needs a seq mesh but
-            # computes the same function as dense — swap it out.
+        if self.model_cfg.attention in ("ring", "ulysses"):
+            # Serving is single-chip; the sequence-parallel cores need a
+            # seq mesh but compute the same function as dense — swap.
             self.model_cfg = dataclasses.replace(self.model_cfg,
                                                  attention="dense")
         self.data_cfg = data_cfg or DataConfig()

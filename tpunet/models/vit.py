@@ -223,6 +223,12 @@ def make_attn_fn(cfg: ModelConfig, mesh=None, causal: bool = False) -> AttnFn:
         from tpunet.ops import ring_self_attention
         return functools.partial(ring_self_attention, mesh=mesh,
                                  causal=causal)
+    if cfg.attention == "ulysses":
+        if mesh is None:
+            raise ValueError("attention='ulysses' requires a mesh")
+        from tpunet.ops import ulysses_self_attention
+        return functools.partial(ulysses_self_attention, mesh=mesh,
+                                 causal=causal)
     raise ValueError(f"unknown attention {cfg.attention!r}")
 
 

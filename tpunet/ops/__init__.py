@@ -6,14 +6,16 @@ Two families live here:
   the VPU-bound hot op of MobileNetV2 (9 multiply-adds per output
   element with no contraction to feed the MXU; the one place a
   hand-written kernel beats XLA's generic conv emitter).
-- ``attention``: dense / blockwise / ring attention. Ring attention is
-  the sequence-parallel primitive (K/V shards rotate over a mesh axis
-  via ppermute with online-softmax accumulation) backing long-context
-  support in the attention-based model families.
+- ``attention``: dense / blockwise / ring / Ulysses attention. Ring
+  (K/V shards rotate over a mesh axis via ppermute with online-softmax
+  accumulation) and Ulysses (all-to-all head resharding around a
+  blockwise core) are the sequence-parallel primitives backing
+  long-context support in the attention-based model families.
 """
 
 from tpunet.ops.attention import (blockwise_attention, dense_attention,
-                                  ring_attention, ring_self_attention)
+                                  ring_attention, ring_self_attention,
+                                  ulysses_attention, ulysses_self_attention)
 from tpunet.ops.depthwise import depthwise_conv3x3, depthwise_conv3x3_reference
 
 __all__ = [
@@ -23,4 +25,6 @@ __all__ = [
     "depthwise_conv3x3_reference",
     "ring_attention",
     "ring_self_attention",
+    "ulysses_attention",
+    "ulysses_self_attention",
 ]

@@ -20,6 +20,10 @@ Design (TPU-first):
   step), each arrival folded in with the same online-softmax update.
   It is written against a shard_map axis name; ``ring_self_attention``
   wraps it in ``jax.shard_map`` over a mesh.
+- ``ulysses_attention`` is the all-to-all sequence-parallel form
+  (DeepSpeed-Ulysses): two ``lax.all_to_all``s trade the seq sharding
+  for head sharding around a locally-dense full-sequence attention.
+  Fewer collectives than the ring; memory O(T) per head group.
 - Layout is [batch, seq, heads, head_dim] (BTHD) throughout.
 - Causal masking uses *global* positions reconstructed from the axis
   index, so causality is exact under sequence sharding.
@@ -201,6 +205,56 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     m, l, acc = _block_update(state, q, k_last, v_last, scale,
                               block_mask(n - 1))
     return _finalize(m, l, acc, q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, *,
+                      causal: bool = False,
+                      scale: Optional[float] = None) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style),
+    shard_map body: inputs arrive seq-sharded [B, T/s, H, D]; one
+    all-to-all (q/k/v stacked, so it is a single collective) re-shards
+    heads instead ([B, T, H/s, D]), attention runs blockwise over the
+    FULL sequence per head group, and a second all-to-all restores seq
+    sharding. Two collectives total per call — fewer than the ring's
+    per-step hops when heads divide the axis — at the cost of holding
+    full-T activations per head group (the scores themselves stay
+    O(T x block) via the blockwise core)."""
+    n = jax.lax.psum(1, axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"{q.shape[2]} heads not divisible by sequence axis {n}")
+    if n == 1:
+        return blockwise_attention(q, k, v, block_size=q.shape[1],
+                                   causal=causal, scale=scale)
+    # [3, B, T/s, H, D] -> [3, B, T, H/s, D]: split heads, concat seq.
+    qkv = jax.lax.all_to_all(jnp.stack([q, k, v]), axis_name,
+                             split_axis=3, concat_axis=2, tiled=True)
+    t_full = qkv.shape[2]
+    block = next(b for b in range(min(512, t_full), 0, -1)
+                 if t_full % b == 0)
+    out = blockwise_attention(qkv[0], qkv[1], qkv[2], block_size=block,
+                              causal=causal, scale=scale)
+    # [B, T, H/s, D] -> [B, T/s, H, D]: split seq, concat heads.
+    return jax.lax.all_to_all(out, axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh, *,
+                           seq_axis: str = "seq",
+                           batch_axis: str = "data",
+                           causal: bool = False,
+                           scale: Optional[float] = None) -> jax.Array:
+    """shard_map wrapper for ``ulysses_attention`` (mirror of
+    ``ring_self_attention``)."""
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=seq_axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
 
 
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,

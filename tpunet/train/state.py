@@ -71,7 +71,7 @@ def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
     if mesh is not None:
         if model_cfg.name == "vit_pp" and mesh.shape.get("pipe", 1) > 1:
             init_batch = mesh.shape["data"] * model_cfg.pp_microbatches
-        elif model_cfg.attention == "ring":
+        elif model_cfg.attention in ("ring", "ulysses"):
             init_batch = mesh.shape["data"]
     variables = init_variables(model, rng, image_size=image_size,
                                batch_size=init_batch, seq_len=seq_len)
