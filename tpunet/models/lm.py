@@ -14,7 +14,8 @@ attention core) -> final LN -> logits against the embedding transpose
 
 Reuses the whole tpunet stack: Trainer epoch loop, psum metrics, Orbax
 checkpointing, TP path rules (the block param names match the ViT
-rules), MoE blocks, and the dense/blockwise/ring attention cores.
+rules), MoE blocks, and the dense/blockwise/ring/ulysses attention
+cores.
 """
 
 from __future__ import annotations

@@ -1,4 +1,4 @@
-"""Attention ops: dense, blockwise, and ring (sequence-parallel) attention.
+"""Attention ops: dense, blockwise, ring and Ulysses (sequence-parallel).
 
 The reference workload is a CNN with no attention anywhere (SURVEY.md
 section 2b), but tpunet treats long-context support as first-class: these
@@ -207,6 +207,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return _finalize(m, l, acc, q.dtype)
 
 
+def _auto_block(t: int) -> int:
+    """Largest divisor of ``t`` that is <= 512 — bounds the blockwise
+    score memory to O(t x 512) regardless of sequence length."""
+    return next(b for b in range(min(512, t), 0, -1) if t % b == 0)
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str, *,
                       causal: bool = False,
@@ -220,20 +226,24 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     per-step hops when heads divide the axis — at the cost of holding
     full-T activations per head group (the scores themselves stay
     O(T x block) via the blockwise core)."""
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(
+            f"ulysses_attention is self-attention only (q {q.shape}, "
+            f"k {k.shape}, v {v.shape}); use ring_attention for "
+            "cross-length attention")
     n = jax.lax.psum(1, axis_name)
     if q.shape[2] % n:
         raise ValueError(
             f"{q.shape[2]} heads not divisible by sequence axis {n}")
     if n == 1:
-        return blockwise_attention(q, k, v, block_size=q.shape[1],
+        return blockwise_attention(q, k, v,
+                                   block_size=_auto_block(q.shape[1]),
                                    causal=causal, scale=scale)
     # [3, B, T/s, H, D] -> [3, B, T, H/s, D]: split heads, concat seq.
     qkv = jax.lax.all_to_all(jnp.stack([q, k, v]), axis_name,
                              split_axis=3, concat_axis=2, tiled=True)
-    t_full = qkv.shape[2]
-    block = next(b for b in range(min(512, t_full), 0, -1)
-                 if t_full % b == 0)
-    out = blockwise_attention(qkv[0], qkv[1], qkv[2], block_size=block,
+    out = blockwise_attention(qkv[0], qkv[1], qkv[2],
+                              block_size=_auto_block(qkv.shape[2]),
                               causal=causal, scale=scale)
     # [B, T, H/s, D] -> [B, T/s, H, D]: split seq, concat heads.
     return jax.lax.all_to_all(out, axis_name, split_axis=1,
