@@ -158,6 +158,23 @@ def test_ulysses_rejects_indivisible_heads():
         ulysses_self_attention(q, q, q, mesh)
 
 
+@pytest.mark.parametrize("impl", [ring_self_attention,
+                                  ulysses_self_attention])
+def test_seq_parallel_with_tensor_parallel_heads(impl):
+    """dp x sp x tp mesh: the head dim stays sharded over 'model'
+    through the sequence-parallel cores (no forced all-gather), and the
+    result still matches dense."""
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "seq", "model"))
+    q, k, v = _qkv(11)  # H=4 heads; 2 per model shard, divisible by seq 2
+    sh = NamedSharding(mesh, P("data", "seq", "model", None))
+    qs, ks, vs = (jax.device_put(a, sh) for a in (q, k, v))
+    out = impl(qs, ks, vs, mesh)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dense_attention(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ring_single_device_axis():
     """seq axis of size 1 degrades to plain blockwise == dense."""
     devs = np.asarray(jax.devices()[:1]).reshape(1, 1)

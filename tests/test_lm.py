@@ -140,3 +140,22 @@ def test_generation():
     assert out.shape == (2, 8)
     assert (np.asarray(out[:, :3]) == np.asarray(prompt)).all()
     assert out.dtype == jnp.int32
+
+
+def test_kv_cache_generation_matches_full_recompute():
+    """Incremental decoding (KV cache, O(L)/token) produces exactly the
+    same greedy continuation as full-prefix recompute — for the dense
+    LM always, and for the MoE LM at this scale (tiny batch -> no
+    capacity drops; with drops, per-step routing may legitimately
+    differ from whole-prefix routing — see generate()'s docstring)."""
+    import dataclasses as dc
+
+    from tpunet.models.lm import generate
+    for kw in ({}, {"moe_experts": 4}):
+        model = create_model(dc.replace(LM_CFG, **kw))
+        variables = init_variables(model, jax.random.PRNGKey(1), seq_len=8)
+        variables = {"params": variables["params"]}
+        prompt = jnp.asarray([[7, 1, 4], [2, 2, 9]], jnp.int32)
+        cached = generate(model, variables, prompt, n_new=5, use_cache=True)
+        full = generate(model, variables, prompt, n_new=5, use_cache=False)
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(full))

@@ -52,7 +52,11 @@ class TransformerLM(nn.Module):
     input_kind = "tokens"              # init_variables dispatch
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, decode: bool = False,
+                 pos_offset=0):
+        """``decode=True``: incremental step against the KV cache (one
+        token per call after cache init); ``pos_offset`` is the absolute
+        position of ``tokens[:, 0]`` in the sequence."""
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -62,10 +66,13 @@ class TransformerLM(nn.Module):
         x = embed(tokens).astype(self.dtype)
         pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
                          (1, self.max_len, self.hidden), self.param_dtype)
-        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, 1).astype(self.dtype)
+        x = x + jax.lax.dynamic_slice_in_dim(
+            pos, pos_offset, t, 1).astype(self.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        Block = (nn.remat(EncoderBlock, static_argnums=(2,))
-                 if self.remat else EncoderBlock)
+        # remat only matters for training; never wrap the decode path.
+        # (both flags are static: argnums count self as 0)
+        Block = (nn.remat(EncoderBlock, static_argnums=(2, 3))
+                 if self.remat and not decode else EncoderBlock)
         for i in range(self.depth):
             moe_here = (self.moe_experts > 0
                         and i % self.moe_every == self.moe_every - 1)
@@ -76,7 +83,7 @@ class TransformerLM(nn.Module):
                              moe_capacity_factor=self.moe_capacity_factor,
                              dropout_rate=self.dropout_rate,
                              dtype=self.dtype, param_dtype=self.param_dtype,
-                             name=f"block{i:02d}")(x, train)
+                             name=f"block{i:02d}")(x, train, decode)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln")(x)
         # Tied output head: logits against the embedding matrix.
@@ -105,28 +112,63 @@ def create_model(cfg: ModelConfig, mesh=None) -> TransformerLM:
 
 
 def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
-             *, temperature: float = 0.0, rng=None):
+             *, temperature: float = 0.0, rng=None,
+             use_cache: bool = True):
     """Greedy (or sampled) autoregressive generation from ``prompt``
     [B, T0] int32.
 
-    Dense models run on a fixed [B, T0+n_new] buffer so the jitted step
-    compiles ONCE (a growing array would recompile every token) —
-    causality makes the not-yet-written future positions irrelevant to
-    the sampled logit. MoE models must instead grow the prefix (one
-    compile per length): capacity-bounded routing couples tokens, so
-    buffer padding would consume expert capacity and change real
-    tokens' logits. Recomputes the prefix each step (no KV cache — fine
-    for the demo/test scale; the attention cores themselves are the
-    long-context story)."""
+    Default path: incremental decoding against the KV cache — O(L) work
+    per token, one jitted single-token program compiled once, prompt
+    prefilled through the same step. Works for every attention config
+    (both cache init and decode steps bypass the injected core). For
+    MoE models note the standard caveat: decode routes each step's
+    tokens with per-step expert capacity, so when experts overflow, the
+    drop set can differ from a full-prefix forward pass (exact equality
+    holds whenever nothing is dropped, e.g. small batches).
+
+    ``use_cache=False`` falls back to full-prefix recompute: dense
+    models reuse a fixed-size buffer (one compile; causality makes the
+    unwritten tail irrelevant), MoE models grow the prefix because
+    buffer padding would consume expert capacity."""
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t0 = prompt.shape
     keys = jax.random.split(rng if rng is not None else jax.random.PRNGKey(0),
-                            n_new)
+                            max(1, n_new))
 
     def pick(lg, key):
         if temperature > 0:
             return jax.random.categorical(key, lg / temperature, -1)
         return jnp.argmax(lg, -1)
+
+    if use_cache:
+        total = t0 + n_new
+        # Shapes only — no initializer FLOPs, no transient param copy.
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((b, total), jnp.int32),
+                               decode=True))
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+        @jax.jit
+        def step(cache, buf, i, key):
+            tok = jax.lax.dynamic_slice(buf, (0, i), (b, 1))
+            logits, mutated = model.apply(
+                {**variables, "cache": cache}, tok, train=False,
+                decode=True, pos_offset=i, mutable=["cache"])
+            nxt = pick(logits[:, 0], key).astype(jnp.int32)
+            # write the prediction at i+1 unless that slot holds prompt
+            buf = jnp.where(
+                jnp.arange(buf.shape[1])[None, :] == i + 1,
+                jnp.where(i + 1 < t0, buf, nxt[:, None]), buf)
+            return mutated["cache"], buf
+
+        buf = jnp.zeros((b, total), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+        for i in range(total - 1):
+            cache, buf = step(cache, buf, jnp.int32(i),
+                              keys[max(0, i - t0 + 1) % len(keys)])
+        return buf
 
     if model.moe_experts > 0:
         tokens = prompt

@@ -39,7 +39,15 @@ AttnFn = Callable[..., jax.Array]  # (q, k, v) BTHD -> BTHD
 
 
 class Attention(nn.Module):
-    """Multi-head self-attention with an injected core attention op."""
+    """Multi-head self-attention with an injected core attention op.
+
+    ``decode=True`` switches to incremental decoding against a KV cache
+    (flax 'cache' collection): the call processes one new token, writes
+    its K/V at the cache index, and attends over the cached prefix —
+    O(T) per step instead of O(T^2) recompute. The cache buffers are
+    created (sized by the input length) when the module is initialized
+    with ``decode=True``; the injected attn_fn is bypassed in this mode
+    (single-query attention is computed inline)."""
 
     heads: int
     attn_fn: AttnFn = dense_attention
@@ -48,7 +56,7 @@ class Attention(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False):
         b, t, c = x.shape
         if c % self.heads:
             raise ValueError(
@@ -58,12 +66,47 @@ class Attention(nn.Module):
                        param_dtype=self.param_dtype, name="qkv")(x)
         qkv = qkv.reshape(b, t, 3, self.heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        y = self.attn_fn(q, k, v)
+        if decode:
+            y = self._decode_attend(q, k, v)
+        else:
+            y = self.attn_fn(q, k, v)
         y = y.reshape(b, t, c)
         y = nn.Dense(c, dtype=self.dtype, param_dtype=self.param_dtype,
                      name="out")(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return y
+
+    def _decode_attend(self, q, k, v):
+        is_init = not self.has_variable("cache", "cached_k")
+        ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
+        cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        if is_init:
+            # init pass (full-length dummy): the buffers are sized from
+            # k/v; skip the attention core entirely (it has no params,
+            # and sharded cores would impose mesh divisibility on the
+            # dummy shape — decode steps never call it).
+            return jnp.zeros_like(q)
+        if q.shape[1] != 1:
+            raise ValueError(
+                f"decode processes one token per call, got {q.shape[1]}")
+        idx = ci.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+        ci.value = idx + 1
+        kf, vf = ck.value, cv.value
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+                       preferred_element_type=jnp.float32)
+        s = s * (q.shape[-1] ** -0.5)
+        # current token sits at idx; only positions <= idx are real.
+        from tpunet.ops.attention import _NEG_INF
+        valid = jnp.arange(kf.shape[1])[None, None, None, :] <= idx
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        y = jnp.einsum("bhqk,bkhd->bqhd", p, vf,
+                       preferred_element_type=jnp.float32)
+        return y.astype(q.dtype)
 
 
 class MlpBlock(nn.Module):
@@ -104,12 +147,13 @@ class EncoderBlock(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln1")(x)
         x = x + Attention(self.heads, attn_fn=self.attn_fn,
                           dropout_rate=self.dropout_rate, dtype=self.dtype,
-                          param_dtype=self.param_dtype, name="attn")(y, train)
+                          param_dtype=self.param_dtype,
+                          name="attn")(y, train, decode)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln2")(x)
         if self.moe_experts > 0:

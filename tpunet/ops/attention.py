@@ -208,9 +208,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _auto_block(t: int) -> int:
-    """Largest divisor of ``t`` that is <= 512 — bounds the blockwise
-    score memory to O(t x 512) regardless of sequence length."""
-    return next(b for b in range(min(512, t), 0, -1) if t % b == 0)
+    """Block size for a length-``t`` blockwise pass: the largest divisor
+    of t that is <= 512, bounding score memory to O(t x 512). Lengths
+    whose only small divisors are degenerate (< 64, e.g. primes — a
+    t-step scan of 1-wide blocks) fall back to one dense pass instead;
+    that trades memory for not serializing the contraction."""
+    if t <= 512:
+        return t
+    b = next(b for b in range(512, 0, -1) if t % b == 0)
+    return b if b >= 64 else t
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -254,11 +260,18 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, *,
                            seq_axis: str = "seq",
                            batch_axis: str = "data",
+                           head_axis: Optional[str] = "model",
                            causal: bool = False,
                            scale: Optional[float] = None) -> jax.Array:
     """shard_map wrapper for ``ulysses_attention`` (mirror of
-    ``ring_self_attention``)."""
-    spec = P(batch_axis, seq_axis, None, None)
+    ``ring_self_attention``, including pass-through tensor-parallel
+    head sharding — local heads must still divide the seq axis)."""
+    h_ax = (head_axis if head_axis and head_axis in mesh.shape
+            and mesh.shape[head_axis] > 1
+            and q.shape[2] % mesh.shape[head_axis] == 0
+            and (q.shape[2] // mesh.shape[head_axis])
+            % mesh.shape[seq_axis] == 0 else None)
+    spec = P(batch_axis, seq_axis, h_ax, None)
     fn = jax.shard_map(
         functools.partial(ulysses_attention, axis_name=seq_axis,
                           causal=causal, scale=scale),
@@ -271,15 +284,21 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         mesh: Mesh, *,
                         seq_axis: str = "seq",
                         batch_axis: str = "data",
+                        head_axis: Optional[str] = "model",
                         causal: bool = False,
                         scale: Optional[float] = None) -> jax.Array:
     """shard_map wrapper: global BTHD arrays in, ring attention inside.
 
-    Batch dim sharded over ``batch_axis``, seq dim over ``seq_axis``;
-    head/depth dims replicated (tensor-parallel head sharding composes
-    at the caller by mapping heads over 'model' before this op).
+    Batch dim sharded over ``batch_axis``, seq dim over ``seq_axis``.
+    When ``head_axis`` names a mesh axis that divides the head count,
+    the head dim stays sharded over it too (attention is elementwise in
+    heads), so tensor-parallel activations flow through without the
+    all-gather an unmentioned axis would force.
     """
-    spec = P(batch_axis, seq_axis, None, None)
+    h_ax = (head_axis if head_axis and head_axis in mesh.shape
+            and mesh.shape[head_axis] > 1
+            and q.shape[2] % mesh.shape[head_axis] == 0 else None)
+    spec = P(batch_axis, seq_axis, h_ax, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=seq_axis,
                           causal=causal, scale=scale),
