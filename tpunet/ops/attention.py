@@ -207,6 +207,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return _finalize(m, l, acc, q.dtype)
 
 
+def _resolve_head_axis(mesh: Mesh, head_axis: Optional[str], heads: int,
+                       local_divisor: int = 1) -> Optional[str]:
+    """Head-dim mesh axis for the shard_map wrappers, or None to
+    replicate: the axis must exist, be >1, and divide the head count
+    (with the per-shard head count further divisible by
+    ``local_divisor`` — Ulysses needs local heads to divide the seq
+    axis)."""
+    if not head_axis or head_axis not in mesh.shape:
+        return None
+    size = mesh.shape[head_axis]
+    if size <= 1 or heads % size or (heads // size) % local_divisor:
+        return None
+    return head_axis
+
+
 def _auto_block(t: int) -> int:
     """Block size for a length-``t`` blockwise pass: the largest divisor
     of t that is <= 512, bounding score memory to O(t x 512). Lengths
@@ -266,11 +281,8 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """shard_map wrapper for ``ulysses_attention`` (mirror of
     ``ring_self_attention``, including pass-through tensor-parallel
     head sharding — local heads must still divide the seq axis)."""
-    h_ax = (head_axis if head_axis and head_axis in mesh.shape
-            and mesh.shape[head_axis] > 1
-            and q.shape[2] % mesh.shape[head_axis] == 0
-            and (q.shape[2] // mesh.shape[head_axis])
-            % mesh.shape[seq_axis] == 0 else None)
+    h_ax = _resolve_head_axis(mesh, head_axis, q.shape[2],
+                              local_divisor=mesh.shape[seq_axis])
     spec = P(batch_axis, seq_axis, h_ax, None)
     fn = jax.shard_map(
         functools.partial(ulysses_attention, axis_name=seq_axis,
@@ -295,9 +307,7 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     heads), so tensor-parallel activations flow through without the
     all-gather an unmentioned axis would force.
     """
-    h_ax = (head_axis if head_axis and head_axis in mesh.shape
-            and mesh.shape[head_axis] > 1
-            and q.shape[2] % mesh.shape[head_axis] == 0 else None)
+    h_ax = _resolve_head_axis(mesh, head_axis, q.shape[2])
     spec = P(batch_axis, seq_axis, h_ax, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=seq_axis,

@@ -251,6 +251,14 @@ class Trainer:
                 record = {
                     "epoch": epoch, "seconds": secs,
                     "step": self.global_step,
+                    # throughput over the epoch (eval pass included),
+                    # in each family's metric unit: images/sec for the
+                    # vision models (comparable with BASELINE.md's
+                    # derived img/s), next-token predictions/sec for
+                    # the LM (its metric count is B*(T-1) per batch).
+                    ("tokens_per_sec" if self.is_lm else
+                     "examples_per_sec"):
+                        round(train_m["count"] / secs, 2),
                     "train_loss": train_m["loss"],
                     "train_accuracy": train_m["accuracy"],
                     "test_loss": test_m["loss"],
