@@ -120,12 +120,14 @@ class ModelConfig:
     # weights to convert (transfer learning is load-bearing for the ~96%
     # accuracy target — reference README.md:24-26).
     pretrained_path: Optional[str] = None
-    # Route 3x3 depthwise convs through the Pallas kernel (tpunet/ops/) —
-    # measured 1.40x faster end-to-end training step on a v5e chip than
-    # XLA's conv emitter (it only takes effect on a TPU backend; CPU
-    # runs use the XLA reference either way). Parameter trees are
-    # identical, so the flag can be flipped on existing checkpoints.
-    use_pallas_depthwise: bool = True
+    # Route 3x3 depthwise convs through the Pallas kernel (tpunet/ops/).
+    # Off by default: with properly synchronized timing the kernel is
+    # ~2.8x SLOWER end-to-end than XLA's conv emitter on a v5e (it is
+    # bit-exact and SPMD-partitioned — kept as the worked TPU-kernel
+    # example and for experimentation). Only takes effect on a TPU
+    # backend; parameter trees are identical either way, so the flag
+    # can be flipped on existing checkpoints.
+    use_pallas_depthwise: bool = False
 
 
 @dataclass(frozen=True)
@@ -292,7 +294,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--pallas-depthwise", default=None,
                    action=argparse.BooleanOptionalAction,
                    help="route 3x3 depthwise convs through the Pallas "
-                        "kernel (default on; TPU-only, 1.40x step speedup)")
+                        "kernel (default off: slower than XLA's conv "
+                        "emitter on v5e, kept for experimentation)")
     return p
 
 
