@@ -144,6 +144,15 @@ class OptimConfig:
     step_size_epochs: int = 10
     gamma: float = 0.1
     label_smoothing: float = 0.0
+    # Gradient accumulation: split each global batch into this many
+    # microbatches inside the jitted step (lax.scan), average the
+    # microbatch gradients, apply ONE optimizer update — 1/N the
+    # activation memory, the lever for reference-scale batches on
+    # small-HBM chips. Gradient math matches the full batch exactly
+    # (mean of equal-sized means) for the LM path; image models differ
+    # benignly: BN stats update per microbatch and each microbatch
+    # draws fresh augmentation/dropout RNG.
+    grad_accum: int = 1
 
 
 @dataclass(frozen=True)
@@ -162,6 +171,11 @@ class MeshConfig:
     # ZeRO-1: shard Adam moments over 'data' (params stay replicated,
     # exactly the reference's layout); GSPMD gathers as needed.
     zero1: bool = False
+    # FSDP / ZeRO-3: shard params AND Adam moments over 'data' (largest
+    # divisible dim per leaf) — 1/N resident param+optimizer memory; the
+    # train step gathers params to their compute layout once at its
+    # start and Adam updates the 1/N moment shards. Subsumes zero1.
+    fsdp: bool = False
 
     def shape(self, n_devices: int) -> Tuple[int, int, int, int]:
         seq = max(1, self.seq)
@@ -264,6 +278,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true",
                    help="shard optimizer moments over the 'data' axis "
                         "(ZeRO-1); params stay replicated")
+    p.add_argument("--fsdp", action="store_true",
+                   help="fully-sharded data parallelism (ZeRO-3): shard "
+                        "params and optimizer moments over 'data'; "
+                        "weights are all-gathered just-in-time")
+    p.add_argument("--grad-accum", type=int, default=None,
+                   help="microbatches accumulated per optimizer step "
+                        "(the global batch is split in time; 1/N the "
+                        "activation memory; full-batch gradient math "
+                        "except per-microbatch BN stats/augment RNG)")
     p.add_argument("--moe-experts", type=int, default=None,
                    help="experts per MoE block (ViT); 0 = dense MLPs")
     p.add_argument("--moe-top-k", type=int, default=None)
@@ -340,6 +363,10 @@ def config_from_args(argv=None) -> TrainConfig:
         model = dataclasses.replace(model, remat=True)
     if args.zero1:
         mesh = dataclasses.replace(mesh, zero1=True)
+    if args.fsdp:
+        mesh = dataclasses.replace(mesh, fsdp=True)
+    if args.grad_accum is not None:
+        optim = dataclasses.replace(optim, grad_accum=args.grad_accum)
     for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads",
                  "moe_experts", "moe_top_k", "moe_every",
                  "moe_capacity_factor", "moe_aux_weight",

@@ -68,15 +68,49 @@ ZERO1_RULES: Rules = (
 )
 
 
+# FSDP / ZeRO-3: parameters AND their Adam moments shard over 'data'.
+# The spec is the FSDP sentinel, resolved per leaf: the largest dim
+# divisible by the data-axis size is sharded (conv kernels are HWIO, so
+# the useful dim is a channel dim, not dim 0; dense kernels shard
+# whichever of in/out features is bigger). The RESIDENT state — params
+# and both Adam moments — is 1/N per device; the train step all-gathers
+# the params once at its start and computes replicated (see
+# _steps_from_micro in tpunet/train/steps.py: left to sharding
+# propagation instead, GSPMD pushes weight shards into attention
+# activations and falls back to involuntary full-rematerialization
+# reshards), while the Adam update itself runs on the 1/N moment
+# shards. batch_stats and the step counter stay replicated. Listed
+# AFTER the model rules, so TP/PP leaves keep their model-axis sharding.
+FSDP = "FSDP"  # sentinel: resolve spec per leaf (largest divisible dim)
+
+FSDP_RULES: Rules = (
+    (r"^params/", FSDP),
+    (r"(^|/)(mu|nu)/", FSDP),
+)
+
+
+def _fsdp_spec(leaf, mesh: Mesh) -> P:
+    n = mesh.shape.get("data", 1)
+    shape = getattr(leaf, "shape", ())
+    if n <= 1 or not shape:
+        return P()
+    best = max((d for d in range(len(shape)) if shape[d] % n == 0),
+               key=lambda d: shape[d], default=None)
+    if best is None or shape[best] < n:
+        return P()
+    return P(*([None] * best + ["data"]))
+
+
 def rules_for(cfg: ModelConfig, mesh: Mesh = None,
-              zero1: bool = False) -> Rules:
+              zero1: bool = False, fsdp: bool = False) -> Rules:
     """Sharding rules for the configured model. MobileNetV2 params stay
     replicated — at 2.2M params a CNN gains nothing from weight sharding
     (the reference's replicated layout is already right for it).
 
     ``mesh`` prunes rules whose axes have size 1 (no-op shardings would
-    otherwise shadow the ZeRO-1 catch-all for those leaves); ``zero1``
-    appends ZERO1_RULES.
+    otherwise shadow the ZeRO-1/FSDP catch-alls for those leaves);
+    ``zero1`` appends ZERO1_RULES; ``fsdp`` appends FSDP_RULES (which
+    subsume ZeRO-1: moments follow their parameter's data-axis shard).
     """
     if cfg.name == "vit_pp":
         rules = VIT_PP_RULES
@@ -92,7 +126,9 @@ def rules_for(cfg: ModelConfig, mesh: Mesh = None,
             (rx, spec) for rx, spec in rules
             if all(mesh.shape.get(ax, 1) > 1
                    for ax in spec if ax is not None))
-    if zero1:
+    if fsdp:
+        rules = tuple(rules) + FSDP_RULES
+    elif zero1:
         rules = tuple(rules) + ZERO1_RULES
     return rules
 
@@ -113,21 +149,24 @@ def _spec_for(path_s: str, leaf, mesh: Mesh, rules) -> P:
     for rx, spec in rules:
         if rx.search(path_s) is None:
             continue
+        if spec is FSDP or spec == FSDP:
+            return _fsdp_spec(leaf, mesh)
         if len(spec) > getattr(leaf, "ndim", 0):
-            break  # rule doesn't fit this leaf; replicate
+            continue  # rule doesn't fit this leaf; try later rules
         ok = True
         for dim, axis in enumerate(spec):
             if axis is None:
                 continue
-            # Replicate instead of crashing when the mesh lacks the rule's
-            # axis (custom meshes) or the dim is indivisible.
+            # Skip the rule instead of crashing when the mesh lacks the
+            # rule's axis (custom meshes) or the dim is indivisible —
+            # later rules (e.g. the FSDP/ZeRO-1 catch-alls) still get a
+            # chance; with none left the leaf replicates.
             if (axis not in mesh.shape
                     or leaf.shape[dim] % mesh.shape[axis] != 0):
                 ok = False
                 break
         if ok:
             return spec
-        break
     return P()
 
 

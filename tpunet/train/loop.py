@@ -71,15 +71,46 @@ class Trainer:
         # is exactly the reference's DDP layout (README:77).
         state_sh = tree_shardings(
             state, self.mesh,
-            rules_for(cfg.model, mesh=self.mesh, zero1=cfg.mesh.zero1))
+            rules_for(cfg.model, mesh=self.mesh, zero1=cfg.mesh.zero1,
+                      fsdp=cfg.mesh.fsdp))
         self.state = jax.device_put(state, state_sh)
 
         # out_shardings pinned: without it XLA may propagate shard_map
         # internals (e.g. a 'seq'-sharded pos-embed gradient) onto the
         # returned state, which would then mismatch in_shardings on the
         # next call.
-        train_fn = (make_lm_train_step(cfg.optim, cfg.model) if self.is_lm
-                    else make_train_step(cfg.data, cfg.optim, cfg.model))
+        accum = cfg.optim.grad_accum
+        if accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {accum}")
+        if cfg.data.batch_size % accum:
+            raise ValueError(
+                f"batch size {cfg.data.batch_size} is not divisible by "
+                f"grad_accum {accum}")
+        ndata = self.mesh.shape.get("data", 1)
+        if (cfg.data.batch_size // accum) % ndata:
+            raise ValueError(
+                f"microbatch {cfg.data.batch_size // accum} "
+                f"(batch {cfg.data.batch_size} / grad_accum {accum}) is "
+                f"not divisible by the data-axis size {ndata}")
+        if cfg.model.name == "vit_pp" and accum > 1:
+            raise ValueError("grad_accum composes with every model except "
+                             "vit_pp (the GPipe executor already "
+                             "microbatches; use --pp-microbatches)")
+        # FSDP gathers params to their COMPUTE layout at step start: the
+        # TP/PP spec (without the FSDP catch-alls) for model/pipe leaves,
+        # replicated for the rest — tensor/pipeline compute sharding is
+        # preserved; only the resting 'data' shard is gathered.
+        gather_sh = None
+        if cfg.mesh.fsdp:
+            gather_sh = tree_shardings(
+                state.params, self.mesh,
+                rules_for(cfg.model, mesh=self.mesh))
+        train_fn = (make_lm_train_step(cfg.optim, cfg.model, self.mesh,
+                                       gather_params=gather_sh)
+                    if self.is_lm
+                    else make_train_step(cfg.data, cfg.optim, cfg.model,
+                                         self.mesh,
+                                         gather_params=gather_sh))
         eval_fn = (make_lm_eval_step() if self.is_lm
                    else make_eval_step(cfg.data))
         self.train_step = jax.jit(

@@ -17,6 +17,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpunet.config import DataConfig, ModelConfig, OptimConfig
 from tpunet.data.augment import make_eval_preprocess, make_train_augment
@@ -42,19 +43,91 @@ def _with_aux(loss, mutated, aux_weight: float):
     return loss
 
 
+def _steps_from_micro(micro: Callable, accum: int, mesh,
+                      gather_params=None) -> Callable:
+    """Lift micro(params, batch_stats, apply_fn, x, y, rng) ->
+    (grads, new_stats, metrics) into train_step(state, x, y, rng).
+
+    accum == 1: one microbatch IS the batch (no scan overhead).
+    accum > 1: the global batch is split into `accum` equal microbatches
+    scanned *in time* — gradients averaged (mean of equal-sized means ==
+    the full-batch mean), BatchNorm stats threaded through microbatches
+    (torch semantics: stats update every forward), ONE optimizer update.
+    Activation memory drops by ~1/accum; the XLA program stays static.
+    The split is STRIDED (microbatch i = rows i, i+accum, ...): under
+    the P('data') batch layout a contiguous split would move most rows
+    off their home device every step, while the strided split maps each
+    device's contiguous rows exactly onto its shard of every microbatch
+    — zero resharding traffic. The partition is irrelevant to the math
+    (the epoch shuffle already randomized row order).
+
+    gather_params (the FSDP path): params are all-gathered ONCE at step
+    start to ``gather_params`` — a params-tree of NamedShardings giving
+    each leaf its COMPUTE layout: the TP/PP spec for model/pipe-sharded
+    leaves (tensor/pipeline compute sharding is preserved, only the
+    FSDP 'data' shard is gathered), replicated for the rest. Left to
+    sharding propagation instead, GSPMD pushes the weight shards into
+    attention activations and falls back to 'involuntary full
+    rematerialization' reshards. The constraint's transpose reshards
+    each weight's gradient straight back to its 'data' shard, and the
+    Adam update then runs on 1/N-sized moment shards — sharded state,
+    DP/TP/PP-layout compute.
+    """
+
+    def train_step(state: TrainState, x, y, rng):
+        params = state.params
+        if gather_params is not None:
+            params = jax.lax.with_sharding_constraint(params, gather_params)
+
+        if accum == 1:
+            grads, stats, m = micro(params, state.batch_stats,
+                                    state.apply_fn, x, y, rng)
+            return state.apply_gradients(grads=grads, batch_stats=stats), m
+
+        mb = x.shape[0] // accum
+        xs = x.reshape(mb, accum, *x.shape[1:]).swapaxes(0, 1)
+        ys = y.reshape(mb, accum, *y.shape[1:]).swapaxes(0, 1)
+        if mesh is not None:
+            sh = lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(None, "data")))
+            xs, ys = sh(xs), sh(ys)
+        rngs = jax.random.split(rng, accum)
+
+        def body(carry, inp):
+            stats, gsum, msum = carry
+            mx, my, mr = inp
+            grads, stats, m = micro(params, stats, state.apply_fn,
+                                    mx, my, mr)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            return (stats, gsum, M.accumulate(msum, m)), None
+
+        gzero = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        (stats, gsum, msum), _ = jax.lax.scan(
+            body, (state.batch_stats, gzero, M.zeros_metrics()),
+            (xs, ys, rngs))
+        grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
+        return state.apply_gradients(grads=grads, batch_stats=stats), msum
+
+    return train_step
+
+
 def make_train_step(data_cfg: DataConfig,
                     optim_cfg: OptimConfig,
-                    model_cfg: Optional[ModelConfig] = None) -> Callable:
+                    model_cfg: Optional[ModelConfig] = None,
+                    mesh=None, gather_params=None) -> Callable:
     """Build train_step(state, images_u8, labels, rng) -> (state, metrics).
 
     ``images_u8`` is the raw (global_batch, 32, 32, 3) uint8 batch;
     augmentation runs inside the step (fused by XLA with the forward).
+    With optim_cfg.grad_accum > 1 the batch is scanned as microbatches;
+    ``gather_params`` is the FSDP compute-layout sharding tree (see
+    _steps_from_micro).
     """
     augment = make_train_augment(data_cfg)
     smoothing = optim_cfg.label_smoothing
     aux_weight = model_cfg.moe_aux_weight if model_cfg is not None else 0.0
 
-    def train_step(state: TrainState, images_u8, labels, rng):
+    def micro(params, batch_stats, apply_fn, images_u8, labels, rng):
         aug_rng, dropout_rng = jax.random.split(rng)
         images = augment(aug_rng, images_u8)
 
@@ -62,8 +135,8 @@ def make_train_step(data_cfg: DataConfig,
             # mutable=["batch_stats"] is harmless for models without
             # BatchNorm (ViT): the mutated collection comes back empty.
             # "losses" carries MoE load-balance terms sown by MoeMlp.
-            logits, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
+            logits, mutated = apply_fn(
+                {"params": params, "batch_stats": batch_stats},
                 images, train=True,
                 rngs={"dropout": dropout_rng},
                 mutable=["batch_stats", "losses"])
@@ -72,17 +145,18 @@ def make_train_step(data_cfg: DataConfig,
             return loss, (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        state = state.apply_gradients(grads=grads, batch_stats=new_stats)
+            loss_fn, has_aux=True)(params)
         n = labels.shape[0]
         correct = jnp.sum(jnp.argmax(logits, -1) == labels)
-        return state, M.from_batch(loss * n, correct, n)
+        return grads, new_stats, M.from_batch(loss * n, correct, n)
 
-    return train_step
+    return _steps_from_micro(micro, max(1, optim_cfg.grad_accum), mesh,
+                             gather_params=gather_params)
 
 
 def make_lm_train_step(optim_cfg: OptimConfig,
-                       model_cfg: ModelConfig) -> Callable:
+                       model_cfg: ModelConfig,
+                       mesh=None, gather_params=None) -> Callable:
     """train_step(state, tokens, _labels, rng) -> (state, metrics) for
     the LM family: targets are the input shifted by one; metrics count
     next-token predictions (accuracy ~0.8 is ceiling on the synthetic
@@ -90,10 +164,10 @@ def make_lm_train_step(optim_cfg: OptimConfig,
     aux_weight = model_cfg.moe_aux_weight
     smoothing = optim_cfg.label_smoothing
 
-    def train_step(state: TrainState, tokens, _labels, rng):
+    def micro(params, batch_stats, apply_fn, tokens, _labels, rng):
         def loss_fn(params):
-            logits, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
+            logits, mutated = apply_fn(
+                {"params": params, "batch_stats": batch_stats},
                 tokens, train=True,
                 rngs={"dropout": rng},
                 mutable=["batch_stats", "losses"])
@@ -103,13 +177,13 @@ def make_lm_train_step(optim_cfg: OptimConfig,
             return loss, (lg, tgt, mutated.get("batch_stats", {}))
 
         (loss, (lg, tgt, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        state = state.apply_gradients(grads=grads, batch_stats=new_stats)
+            loss_fn, has_aux=True)(params)
         n = tgt.size
         correct = jnp.sum(jnp.argmax(lg, -1) == tgt)
-        return state, M.from_batch(loss * n, correct, n)
+        return grads, new_stats, M.from_batch(loss * n, correct, n)
 
-    return train_step
+    return _steps_from_micro(micro, max(1, optim_cfg.grad_accum), mesh,
+                             gather_params=gather_params)
 
 
 def make_lm_eval_step() -> Callable:
