@@ -1,0 +1,206 @@
+"""Optimization-stack upgrades: LR schedules (warmup/cosine), global
+gradient-norm clipping, and parameter EMA.
+
+The reference's stack is fixed (Adam 1e-4 + StepLR(10, 0.1),
+cifar10_mpi_mobilenet_224.py:147-149) and stays the default; these are
+beyond-parity options and must not disturb that default.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, OptimConfig, TrainConfig)
+from tpunet.train.loop import Trainer
+from tpunet.train.state import lr_schedule, make_optimizer
+
+LM_CFG = ModelConfig(name="lm", vit_hidden=64, vit_depth=2, vit_heads=4,
+                     dropout_rate=0.0, dtype="float32", vocab_size=32,
+                     max_seq_len=64)
+
+
+def _lm_cfg(optim, mesh=None, epochs=1):
+    return TrainConfig(
+        epochs=epochs,
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        synthetic_train_size=64, synthetic_test_size=16,
+                        seq_len=64, vocab_size=32),
+        model=LM_CFG,
+        optim=optim,
+        mesh=mesh or MeshConfig(),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+
+
+# ------------------------------------------------------------ schedules
+
+
+def test_step_schedule_is_reference_steplr():
+    """lr=1e-4, decay x0.1 at epochs 10 and 20 (StepLR(10, 0.1))."""
+    fn = lr_schedule(OptimConfig(), steps_per_epoch=100, epochs=20)
+    assert float(fn(0)) == pytest.approx(1e-4)
+    assert float(fn(999)) == pytest.approx(1e-4)
+    assert float(fn(1000)) == pytest.approx(1e-5)
+    assert float(fn(1999)) == pytest.approx(1e-5)
+
+
+def test_cosine_schedule_decays_to_zero():
+    fn = lr_schedule(OptimConfig(schedule="cosine"), steps_per_epoch=100,
+                     epochs=10)
+    assert float(fn(0)) == pytest.approx(1e-4)
+    assert float(fn(500)) == pytest.approx(5e-5, rel=1e-3)  # half-way
+    assert float(fn(1000)) == pytest.approx(0.0, abs=1e-10)
+
+
+def test_warmup_composes_with_any_schedule():
+    # 1 epoch warmup then constant
+    fn = lr_schedule(OptimConfig(schedule="constant", warmup_epochs=1.0),
+                     steps_per_epoch=100, epochs=10)
+    assert float(fn(0)) == pytest.approx(0.0)
+    assert float(fn(50)) == pytest.approx(5e-5)
+    assert float(fn(100)) == pytest.approx(1e-4)
+    assert float(fn(900)) == pytest.approx(1e-4)
+    # warmup + cosine: the cosine clock starts at warmup end
+    fn = lr_schedule(OptimConfig(schedule="cosine", warmup_epochs=1.0),
+                     steps_per_epoch=100, epochs=11)
+    assert float(fn(100)) == pytest.approx(1e-4)
+    assert float(fn(600)) == pytest.approx(5e-5, rel=1e-3)
+    assert float(fn(1100)) == pytest.approx(0.0, abs=1e-10)
+
+
+def test_unknown_schedule_raises():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        lr_schedule(OptimConfig(schedule="nope"), 10, 1)
+
+
+# ------------------------------------------------------------- clipping
+
+
+def test_clip_norm_bounds_the_update():
+    params = {"w": jnp.zeros(4)}
+    grads = {"w": jnp.full(4, 50.0)}  # global norm 100
+    tx = make_optimizer(OptimConfig(name="sgd", schedule="constant",
+                                    learning_rate=1.0, clip_norm=1.0),
+                        steps_per_epoch=1, epochs=1)
+    st = tx.init(params)
+    updates, _ = tx.update(grads, st, params)
+    norm = float(jnp.linalg.norm(updates["w"]))
+    assert norm == pytest.approx(1.0, rel=1e-5)  # clipped then lr=1 sgd
+    # without clipping the same update has norm 100
+    tx = make_optimizer(OptimConfig(name="sgd", schedule="constant",
+                                    learning_rate=1.0),
+                        steps_per_epoch=1, epochs=1)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    assert float(jnp.linalg.norm(updates["w"])) == pytest.approx(100.0,
+                                                                 rel=1e-5)
+
+
+def test_clip_norm_trains_and_moment_rules_still_match():
+    trainer = Trainer(_lm_cfg(OptimConfig(learning_rate=3e-3,
+                                          clip_norm=1.0)))
+    try:
+        m = trainer.train_one_epoch(1)
+        assert np.isfinite(m["loss"])
+        # Adam state nests one level deeper inside the chain; path-rule
+        # moment matching is positional-path-based and must still find
+        # mu/nu leaves (exercised properly in the zero1 variant below).
+        flat = jax.tree_util.tree_leaves(trainer.state.opt_state)
+        assert len(flat) > 2
+    finally:
+        trainer.close()
+
+
+def test_clip_norm_composes_with_zero1():
+    trainer = Trainer(_lm_cfg(OptimConfig(learning_rate=3e-3,
+                                          clip_norm=1.0),
+                              mesh=MeshConfig(data=8, zero1=True)))
+    try:
+        specs = [str(l.sharding.spec) for l in
+                 jax.tree_util.tree_leaves(trainer.state.opt_state)
+                 if hasattr(l, "sharding")]
+        assert any("data" in s for s in specs), specs
+        m = trainer.train_one_epoch(1)
+        assert np.isfinite(m["loss"])
+    finally:
+        trainer.close()
+
+
+# ------------------------------------------------------------------ EMA
+
+
+def test_ema_decay_out_of_range_raises():
+    """decay >= 1 would silently freeze the EMA at the random init."""
+    with pytest.raises(ValueError, match="ema_decay"):
+        Trainer(_lm_cfg(OptimConfig(ema_decay=1.0)))
+    with pytest.raises(ValueError, match="ema_decay"):
+        Trainer(_lm_cfg(OptimConfig(ema_decay=-0.1)))
+
+
+def test_evaluate_reads_ema_params():
+    """Swap the EMA tree for all-zero weights: a zero LM emits all-zero
+    logits, so evaluate() must report exactly uniform CE = ln(vocab) if
+    (and only if) it evaluates ema_params rather than params."""
+    trainer = Trainer(_lm_cfg(OptimConfig(learning_rate=3e-3,
+                                          ema_decay=0.5)))
+    try:
+        trainer.train_one_epoch(1)
+        zeros = jax.tree_util.tree_map(jnp.zeros_like,
+                                       trainer.state.ema_params)
+        trainer.state = trainer.state.replace(ema_params=zeros)
+        m = trainer.evaluate()
+        assert m["loss"] == pytest.approx(float(jnp.log(32.0)), rel=1e-5)
+    finally:
+        trainer.close()
+
+
+def test_ema_tracks_params():
+    trainer = Trainer(_lm_cfg(OptimConfig(learning_rate=3e-3,
+                                          ema_decay=0.5)))
+    try:
+        trainer.train_one_epoch(1)
+        p = np.asarray(trainer.state.params["embed"]["embedding"])
+        e = np.asarray(trainer.state.ema_params["embed"]["embedding"])
+        assert not np.allclose(p, e)
+        assert np.abs(e - p).max() < 0.1  # decay 0.5 hugs the params
+    finally:
+        trainer.close()
+
+
+def test_ema_disabled_is_empty_and_eval_uses_params():
+    trainer = Trainer(_lm_cfg(OptimConfig(learning_rate=3e-3)))
+    try:
+        assert trainer.state.ema_params == {}
+        trainer.train_one_epoch(1)
+        assert np.isfinite(trainer.evaluate()["loss"])
+    finally:
+        trainer.close()
+
+
+def test_ema_composes_with_fsdp():
+    trainer = Trainer(_lm_cfg(OptimConfig(learning_rate=3e-3,
+                                          ema_decay=0.9),
+                              mesh=MeshConfig(data=8, fsdp=True)))
+    try:
+        qkv = trainer.state.params["block00"]["attn"]["qkv"]["kernel"]
+        eqkv = trainer.state.ema_params["block00"]["attn"]["qkv"]["kernel"]
+        assert qkv.sharding.spec == eqkv.sharding.spec != P()
+        trainer.train_one_epoch(1)
+        assert np.isfinite(trainer.evaluate()["loss"])
+    finally:
+        trainer.close()
+
+
+def test_cli_flags():
+    from tpunet.config import config_from_args
+    cfg = config_from_args(["--lr-schedule", "cosine", "--warmup-epochs",
+                            "0.5", "--clip-norm", "1.0", "--ema-decay",
+                            "0.999"])
+    assert cfg.optim.schedule == "cosine"
+    assert cfg.optim.warmup_epochs == 0.5
+    assert cfg.optim.clip_norm == 1.0
+    assert cfg.optim.ema_decay == 0.999

@@ -140,10 +140,21 @@ class OptimConfig:
     b2: float = 0.999
     eps: float = 1e-8
     weight_decay: float = 0.0
-    # StepLR equivalents: decay lr by `gamma` every `step_size_epochs`.
+    # LR schedule: "step" is the reference's StepLR (decay by `gamma`
+    # every `step_size_epochs`); "cosine" decays to 0 over training;
+    # "constant" holds the base rate. `warmup_epochs` (fractional ok)
+    # prepends a linear warmup from 0 to any of them.
+    schedule: str = "step"
     step_size_epochs: int = 10
     gamma: float = 0.1
+    warmup_epochs: float = 0.0
     label_smoothing: float = 0.0
+    # Global-gradient-norm clipping (torch clip_grad_norm_ idiom);
+    # 0 = off (the reference does not clip).
+    clip_norm: float = 0.0
+    # Parameter EMA decay (e.g. 0.999); 0 = off. When on, evaluation
+    # and the best-checkpoint use the EMA weights.
+    ema_decay: float = 0.0
     # Gradient accumulation: split each global batch into this many
     # microbatches inside the jitted step (lax.scan), average the
     # microbatch gradients, apply ONE optimizer update — 1/N the
@@ -247,6 +258,18 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="global batch size")
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr-schedule", default=None,
+                   choices=["step", "cosine", "constant"],
+                   help="step = the reference's StepLR(10, 0.1); cosine "
+                        "decays to 0 over training")
+    p.add_argument("--warmup-epochs", type=float, default=None,
+                   help="linear LR warmup over this many (fractional) "
+                        "epochs, before any schedule")
+    p.add_argument("--clip-norm", type=float, default=None,
+                   help="global gradient-norm clip; 0 = off")
+    p.add_argument("--ema-decay", type=float, default=None,
+                   help="parameter EMA decay (e.g. 0.999); eval and the "
+                        "best checkpoint use the EMA weights; 0 = off")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--dataset", default=None,
@@ -383,6 +406,14 @@ def config_from_args(argv=None) -> TrainConfig:
         model = dataclasses.replace(model, dtype=args.dtype)
     if args.lr is not None:
         optim = dataclasses.replace(optim, learning_rate=args.lr)
+    if args.lr_schedule is not None:
+        optim = dataclasses.replace(optim, schedule=args.lr_schedule)
+    if args.warmup_epochs is not None:
+        optim = dataclasses.replace(optim, warmup_epochs=args.warmup_epochs)
+    if args.clip_norm is not None:
+        optim = dataclasses.replace(optim, clip_norm=args.clip_norm)
+    if args.ema_decay is not None:
+        optim = dataclasses.replace(optim, ema_decay=args.ema_decay)
     if args.mesh_data is not None:
         mesh = dataclasses.replace(mesh, data=args.mesh_data)
     if args.mesh_seq is not None:

@@ -85,6 +85,10 @@ FSDP = "FSDP"  # sentinel: resolve spec per leaf (largest divisible dim)
 
 FSDP_RULES: Rules = (
     (r"^params/", FSDP),
+    # EMA params mirror the param tree shape-for-shape, so they get the
+    # identical per-leaf spec; state.replace(params=ema_params) at eval
+    # time then matches the jitted eval step's in_shardings exactly.
+    (r"^ema_params/", FSDP),
     (r"(^|/)(mu|nu)/", FSDP),
 )
 

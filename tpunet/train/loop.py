@@ -82,6 +82,11 @@ class Trainer:
         accum = cfg.optim.grad_accum
         if accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {accum}")
+        if not 0.0 <= cfg.optim.ema_decay < 1.0:
+            # decay >= 1 silently freezes the EMA at the random init and
+            # eval/best-checkpoint would measure that forever.
+            raise ValueError(f"ema_decay must be in [0, 1), got "
+                             f"{cfg.optim.ema_decay}")
         if cfg.data.batch_size % accum:
             raise ValueError(
                 f"batch size {cfg.data.batch_size} is not divisible by "
@@ -111,8 +116,8 @@ class Trainer:
                     else make_train_step(cfg.data, cfg.optim, cfg.model,
                                          self.mesh,
                                          gather_params=gather_sh))
-        eval_fn = (make_lm_eval_step() if self.is_lm
-                   else make_eval_step(cfg.data))
+        eval_fn = (make_lm_eval_step(gather_params=gather_sh) if self.is_lm
+                   else make_eval_step(cfg.data, gather_params=gather_sh))
         self.train_step = jax.jit(
             train_fn,
             in_shardings=(state_sh, bsh, bsh, repl),
@@ -228,6 +233,12 @@ class Trainer:
 
     def evaluate(self) -> Dict[str, float]:
         cfg = self.cfg
+        state = self.state
+        if cfg.optim.ema_decay > 0:
+            # Evaluate the EMA weights (what the best-checkpoint saves).
+            # ema_params mirrors params shape-for-shape and shard-for-
+            # shard (tp.py FSDP_RULES), so in_shardings still match.
+            state = state.replace(params=state.ema_params)
         acc = None
         for bx, by, bm in eval_batches(
                 self.test_x, self.test_y,
@@ -236,7 +247,7 @@ class Trainer:
                 process_count=jax.process_count()):
             gx, gy, gm = shard_host_batch(
                 self.mesh, bx, by.astype(np.int32), bm)
-            m = self.eval_step(self.state, gx, gy, gm)
+            m = self.eval_step(state, gx, gy, gm)
             acc = m if acc is None else M.accumulate(acc, m)
         return M.summarize(acc if acc is not None else M.zeros_metrics())
 
@@ -299,8 +310,12 @@ class Trainer:
                 metrics_log.log(record)
                 if test_m["accuracy"] > self.best_acc:
                     self.best_acc = test_m["accuracy"]
+                    # With EMA on, the test accuracy was measured on the
+                    # EMA weights — save those (what inference loads).
                     self.ckpt.save_best({
-                        "params": self.state.params,
+                        "params": (self.state.ema_params
+                                   if cfg.optim.ema_decay > 0
+                                   else self.state.params),
                         "batch_stats": self.state.batch_stats,
                     })
                 self.start_epoch = epoch

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import optax
 from flax.training import train_state
 
@@ -22,33 +23,67 @@ from tpunet.models.convert import load_pretrained
 
 
 class TrainState(train_state.TrainState):
-    """flax TrainState + BatchNorm running statistics."""
+    """flax TrainState + BatchNorm running statistics + optional
+    parameter EMA (``ema_params`` is {} when ema_decay == 0; when
+    enabled, evaluation and the best-checkpoint use the EMA weights)."""
 
     batch_stats: Any = None
+    ema_params: Any = None
 
 
 def lr_schedule(cfg: OptimConfig, steps_per_epoch: int, epochs: int):
-    """StepLR(step_size, gamma) as a step-indexed schedule."""
-    boundaries = {
-        e * steps_per_epoch: cfg.gamma
-        for e in range(cfg.step_size_epochs, epochs + 1, cfg.step_size_epochs)
-    }
-    if not boundaries:
-        return cfg.learning_rate
-    return optax.piecewise_constant_schedule(cfg.learning_rate, boundaries)
+    """Step-indexed learning-rate schedule.
+
+    ``schedule="step"`` is the reference's StepLR(step_size, gamma)
+    (cifar10_mpi_mobilenet_224.py:149); "cosine" decays to 0 over the
+    remaining steps; "constant" holds the base rate. A linear warmup of
+    ``warmup_epochs`` (fractional epochs allowed) composes with any of
+    them — the base schedule's clock starts when warmup ends
+    (optax.join_schedules offsets the count)."""
+    total = steps_per_epoch * epochs
+    warm = int(round(cfg.warmup_epochs * steps_per_epoch))
+    if cfg.schedule == "step":
+        boundaries = {
+            e * steps_per_epoch: cfg.gamma
+            for e in range(cfg.step_size_epochs, epochs + 1,
+                           cfg.step_size_epochs)
+        }
+        base = (optax.piecewise_constant_schedule(cfg.learning_rate,
+                                                  boundaries)
+                if boundaries else optax.constant_schedule(cfg.learning_rate))
+    elif cfg.schedule == "cosine":
+        base = optax.cosine_decay_schedule(cfg.learning_rate,
+                                           max(1, total - warm))
+    elif cfg.schedule == "constant":
+        base = optax.constant_schedule(cfg.learning_rate)
+    else:
+        raise ValueError(f"unknown schedule {cfg.schedule!r}; "
+                         "expected step|cosine|constant")
+    if warm > 0:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, cfg.learning_rate, warm), base],
+            [warm])
+    return base
 
 
 def make_optimizer(cfg: OptimConfig, steps_per_epoch: int,
                    epochs: int) -> optax.GradientTransformation:
     schedule = lr_schedule(cfg, steps_per_epoch, epochs)
     if cfg.name == "adam" and cfg.weight_decay == 0.0:
-        return optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
-    if cfg.name in ("adam", "adamw"):
-        return optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-                           weight_decay=cfg.weight_decay)
-    if cfg.name == "sgd":
-        return optax.sgd(schedule, momentum=0.9)
-    raise ValueError(f"unknown optimizer {cfg.name!r}")
+        tx = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+    elif cfg.name in ("adam", "adamw"):
+        tx = optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                         weight_decay=cfg.weight_decay)
+    elif cfg.name == "sgd":
+        tx = optax.sgd(schedule, momentum=0.9)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    if cfg.clip_norm > 0:
+        # Global-norm clip BEFORE the optimizer (the torch idiom
+        # clip_grad_norm_-then-step); moment path rules still match —
+        # the Adam state just nests one level deeper in the chain.
+        tx = optax.chain(optax.clip_by_global_norm(cfg.clip_norm), tx)
+    return tx
 
 
 def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
@@ -83,9 +118,14 @@ def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
         variables = load_pretrained(model_cfg.pretrained_path, variables,
                                     num_classes=model_cfg.num_classes)
     tx = make_optimizer(optim_cfg, steps_per_epoch, epochs)
+    params = variables["params"]
     return TrainState.create(
         apply_fn=model.apply,
-        params=variables["params"],
+        params=params,
         batch_stats=variables.get("batch_stats", {}),
+        # EMA starts AT the initial params (torch.optim.swa_utils
+        # convention); {} when disabled so the pytree stays minimal.
+        ema_params=(jax.tree_util.tree_map(jnp.array, params)
+                    if optim_cfg.ema_decay > 0 else {}),
         tx=tx,
     )

@@ -44,7 +44,7 @@ def _with_aux(loss, mutated, aux_weight: float):
 
 
 def _steps_from_micro(micro: Callable, accum: int, mesh,
-                      gather_params=None) -> Callable:
+                      gather_params=None, ema_decay: float = 0.0) -> Callable:
     """Lift micro(params, batch_stats, apply_fn, x, y, rng) ->
     (grads, new_stats, metrics) into train_step(state, x, y, rng).
 
@@ -74,6 +74,15 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
     DP/TP/PP-layout compute.
     """
 
+    def finish(state, grads, stats):
+        state = state.apply_gradients(grads=grads, batch_stats=stats)
+        if ema_decay > 0:
+            # EMA tracks the POST-update params; eval/best-ckpt read it.
+            state = state.replace(ema_params=jax.tree_util.tree_map(
+                lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                state.ema_params, state.params))
+        return state
+
     def train_step(state: TrainState, x, y, rng):
         params = state.params
         if gather_params is not None:
@@ -82,7 +91,7 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
         if accum == 1:
             grads, stats, m = micro(params, state.batch_stats,
                                     state.apply_fn, x, y, rng)
-            return state.apply_gradients(grads=grads, batch_stats=stats), m
+            return finish(state, grads, stats), m
 
         mb = x.shape[0] // accum
         xs = x.reshape(mb, accum, *x.shape[1:]).swapaxes(0, 1)
@@ -106,7 +115,7 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
             body, (state.batch_stats, gzero, M.zeros_metrics()),
             (xs, ys, rngs))
         grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
-        return state.apply_gradients(grads=grads, batch_stats=stats), msum
+        return finish(state, grads, stats), msum
 
     return train_step
 
@@ -151,7 +160,8 @@ def make_train_step(data_cfg: DataConfig,
         return grads, new_stats, M.from_batch(loss * n, correct, n)
 
     return _steps_from_micro(micro, max(1, optim_cfg.grad_accum), mesh,
-                             gather_params=gather_params)
+                             gather_params=gather_params,
+                             ema_decay=optim_cfg.ema_decay)
 
 
 def make_lm_train_step(optim_cfg: OptimConfig,
@@ -183,16 +193,23 @@ def make_lm_train_step(optim_cfg: OptimConfig,
         return grads, new_stats, M.from_batch(loss * n, correct, n)
 
     return _steps_from_micro(micro, max(1, optim_cfg.grad_accum), mesh,
-                             gather_params=gather_params)
+                             gather_params=gather_params,
+                             ema_decay=optim_cfg.ema_decay)
 
 
-def make_lm_eval_step() -> Callable:
+def make_lm_eval_step(gather_params=None) -> Callable:
     """eval_step(state, tokens, _labels, mask) -> metrics; ``mask`` [B]
-    zeroes padded sequences so the test set is counted exactly."""
+    zeroes padded sequences so the test set is counted exactly.
+    ``gather_params``: FSDP compute-layout tree, same as the train step
+    (without it the eval forward re-runs under the pathological GSPMD
+    propagation the train step avoids)."""
 
     def eval_step(state: TrainState, tokens, _labels, mask):
+        params = state.params
+        if gather_params is not None:
+            params = jax.lax.with_sharding_constraint(params, gather_params)
         logits = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
+            {"params": params, "batch_stats": state.batch_stats},
             tokens, train=False)
         lg, tgt = logits[:, :-1], tokens[:, 1:]
         losses = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
@@ -204,18 +221,22 @@ def make_lm_eval_step() -> Callable:
     return eval_step
 
 
-def make_eval_step(data_cfg: DataConfig) -> Callable:
+def make_eval_step(data_cfg: DataConfig, gather_params=None) -> Callable:
     """Build eval_step(state, images_u8, labels, mask) -> metrics.
 
     ``mask`` zeroes padded examples so the test set is counted exactly
     (fixes the reference's local-approximate accuracy, :196,224).
+    ``gather_params``: FSDP compute-layout tree, as in the train step.
     """
     preprocess = make_eval_preprocess(data_cfg)
 
     def eval_step(state: TrainState, images_u8, labels, mask):
+        params = state.params
+        if gather_params is not None:
+            params = jax.lax.with_sharding_constraint(params, gather_params)
         images = preprocess(images_u8)
         logits = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
+            {"params": params, "batch_stats": state.batch_stats},
             images, train=False)
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels)
