@@ -181,6 +181,42 @@ def test_ema_disabled_is_empty_and_eval_uses_params():
         trainer.close()
 
 
+def test_ema_covers_batch_stats_for_bn_models():
+    """BatchNorm models must evaluate/save EMA params WITH EMA running
+    stats — pairing EMA weights with live stats mismatches the
+    normalization (the torch swa_utils update_bn problem)."""
+    cfg = TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic", image_size=32, batch_size=16,
+                        synthetic_train_size=32, synthetic_test_size=16),
+        model=ModelConfig(width_mult=0.5, dtype="float32"),
+        optim=OptimConfig(ema_decay=0.5),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    trainer = Trainer(cfg)
+    try:
+        init_stats = jax.tree_util.tree_map(np.asarray,
+                                            trainer.state.ema_batch_stats)
+        assert jax.tree_util.tree_leaves(init_stats)  # BN model: nonempty
+        trainer.train_one_epoch(1)
+        moved = jax.tree_util.tree_map(
+            lambda a, b: not np.allclose(np.asarray(a), np.asarray(b)),
+            trainer.state.ema_batch_stats, init_stats)
+        assert any(jax.tree_util.tree_leaves(moved))
+        # same tree structure as the live stats -> eval/save can swap
+        assert (jax.tree_util.tree_structure(trainer.state.ema_batch_stats)
+                == jax.tree_util.tree_structure(trainer.state.batch_stats))
+        assert np.isfinite(trainer.evaluate()["loss"])
+    finally:
+        trainer.close()
+
+
+def test_warmup_longer_than_run_raises():
+    with pytest.raises(ValueError, match="warmup_epochs"):
+        Trainer(_lm_cfg(OptimConfig(warmup_epochs=2.0), epochs=1))
+
+
 def test_ema_composes_with_fsdp():
     trainer = Trainer(_lm_cfg(OptimConfig(learning_rate=3e-3,
                                           ema_decay=0.9),

@@ -53,11 +53,14 @@ class DataConfig:
     # Synthetic-dataset sizes (CIFAR-10-shaped stand-in for hermetic runs).
     synthetic_train_size: int = 50_000
     synthetic_test_size: int = 10_000
-    # Token datasets (dataset="synthetic_lm", model "lm"): sequence
-    # length and vocab of the generated bigram data. vocab_size must
-    # match ModelConfig.vocab_size (the CLI --vocab-size sets both).
+    # Token datasets (model "lm"): "synthetic_lm" generates seeded
+    # bigram data with this sequence length and vocab; "text_lm" chunks
+    # the raw bytes of `text_path` (byte-level, vocab 256, no
+    # tokenizer/downloads). vocab_size must match ModelConfig.vocab_size
+    # (the CLI --vocab-size sets both).
     seq_len: int = 128
     vocab_size: int = 256
+    text_path: str = ""
     # Deviation from torch DistributedSampler (which pads shards to equal
     # length, :119-124): we drop the train remainder and evaluate the test
     # set exactly (padding with masked examples), which also fixes the
@@ -273,7 +276,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--dataset", default=None,
-                   choices=["cifar10", "synthetic", "synthetic_lm"])
+                   choices=["cifar10", "synthetic", "synthetic_lm",
+                            "text_lm"])
+    p.add_argument("--text-file", default=None,
+                   help="byte-level corpus file for --dataset text_lm")
     p.add_argument("--pretrained", default=None,
                    help="path to a torch MobileNetV2 state_dict to convert")
     p.add_argument("--model", default=None,
@@ -359,6 +365,8 @@ def config_from_args(argv=None) -> TrainConfig:
         data = dataclasses.replace(data, dataset=args.dataset)
     if args.no_native_loader:
         data = dataclasses.replace(data, native_loader=False)
+    if args.text_file is not None:
+        data = dataclasses.replace(data, text_path=args.text_file)
     if args.seq_len is not None:
         data = dataclasses.replace(data, seq_len=args.seq_len)
     if args.max_seq_len is not None:

@@ -91,7 +91,7 @@ def get_dataset(cfg: DataConfig) -> Arrays:
                                  n_test=cfg.synthetic_test_size)
     if cfg.dataset == "cifar10":
         return load_cifar10(cfg.data_dir)
-    if cfg.dataset == "synthetic_lm":
+    if cfg.dataset in ("synthetic_lm", "text_lm"):
         from tpunet.data.lm import get_lm_dataset
         return get_lm_dataset(cfg)
     raise ValueError(f"unknown dataset {cfg.dataset!r}")

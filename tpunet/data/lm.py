@@ -43,8 +43,38 @@ def synthetic_lm(n_train: int, n_test: int, seq_len: int = 128,
             test_x, np.zeros(n_test, np.int32))
 
 
+def text_lm(path: str, seq_len: int, train_frac: float = 0.9) -> Arrays:
+    """Byte-level LM dataset from a local file: the raw bytes ARE the
+    tokens (vocab 256, no tokenizer, no downloads — works in no-egress
+    environments on any text/corpus file). The stream is chunked into
+    non-overlapping seq_len windows; the TAIL fraction is the test split
+    (contiguous, so train/test measure held-out text, not shuffled
+    leakage from the same passages)."""
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    n_seq = len(data) // seq_len
+    if n_seq < 2:
+        raise ValueError(
+            f"{path!r} has {len(data)} bytes; need at least "
+            f"2*seq_len = {2 * seq_len} for a train/test split")
+    toks = data[:n_seq * seq_len].reshape(n_seq, seq_len).astype(np.int32)
+    n_train = min(n_seq - 1, max(1, int(round(n_seq * train_frac))))
+    train_x, test_x = toks[:n_train], toks[n_train:]
+    return (train_x, np.zeros(len(train_x), np.int32),
+            test_x, np.zeros(len(test_x), np.int32))
+
+
 def get_lm_dataset(cfg: DataConfig) -> Arrays:
-    if cfg.dataset != "synthetic_lm":
-        raise ValueError(f"unknown LM dataset {cfg.dataset!r}")
-    return synthetic_lm(cfg.synthetic_train_size, cfg.synthetic_test_size,
-                        seq_len=cfg.seq_len, vocab=cfg.vocab_size)
+    if cfg.dataset == "synthetic_lm":
+        return synthetic_lm(cfg.synthetic_train_size,
+                            cfg.synthetic_test_size,
+                            seq_len=cfg.seq_len, vocab=cfg.vocab_size)
+    if cfg.dataset == "text_lm":
+        if not cfg.text_path:
+            raise ValueError("dataset 'text_lm' needs a file: --text-file")
+        if cfg.vocab_size < 256:
+            raise ValueError(
+                f"text_lm is byte-level: vocab_size must be >= 256, got "
+                f"{cfg.vocab_size}")
+        return text_lm(cfg.text_path, cfg.seq_len)
+    raise ValueError(f"unknown LM dataset {cfg.dataset!r}")

@@ -1,1 +1,2 @@
 from tpunet.infer.predict import Predictor, PredictionResult  # noqa: F401
+from tpunet.infer.generate import generate_text, load_lm  # noqa: F401

@@ -1,0 +1,128 @@
+"""Text generation CLI for the LM family.
+
+The reference serves its vision model through a predict helper and a
+Gradio app (cifar10_serial_mobilenet_224.py:159-188, GROUP03.pdf
+pp.22-23); this is the LM family's serving analogue: load the best
+checkpoint, prefill the prompt, and decode autoregressively through the
+KV-cache incremental path (tpunet.models.lm.generate — one compiled
+single-token program, O(L) per token). Byte-level checkpoints
+(--dataset text_lm training) round-trip UTF-8 text; other vocabs print
+token ids.
+
+    python -m tpunet.infer.generate --checkpoint-dir ckpt \
+        --prompt "The " --tokens 256 --temperature 0.8
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from tpunet.ckpt import Checkpointer
+from tpunet.config import CheckpointConfig, ModelConfig
+from tpunet.models import create_model, init_variables
+from tpunet.models.lm import generate
+
+
+def load_lm(model_cfg: ModelConfig,
+            checkpoint_dir: Optional[str] = None,
+            variables: Optional[dict] = None) -> Tuple[object, dict]:
+    """Build the LM and load its best-checkpoint params (serving is
+    single-chip: sequence-parallel attention configs swap to dense,
+    same function — mirrors infer.Predictor)."""
+    if model_cfg.name != "lm":
+        raise ValueError(f"generation needs the 'lm' model, got "
+                         f"{model_cfg.name!r}")
+    if model_cfg.attention in ("ring", "ulysses"):
+        model_cfg = dataclasses.replace(model_cfg, attention="dense")
+    model = create_model(model_cfg)
+    if variables is None:
+        variables = init_variables(model, jax.random.PRNGKey(0),
+                                   seq_len=min(16, model_cfg.max_seq_len))
+        if checkpoint_dir:
+            ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
+            best = ckpt.restore_best({"params": variables["params"],
+                                      "batch_stats": {}})
+            if best is None:
+                raise FileNotFoundError(
+                    f"no best checkpoint under {checkpoint_dir!r}")
+            variables = {"params": best["params"]}
+    return model, {"params": variables["params"]}
+
+
+def generate_text(model, variables, prompt: str, n_new: int,
+                  temperature: float = 0.0, seed: int = 0) -> str:
+    """Byte-level helper: UTF-8 prompt in, UTF-8 continuation out."""
+    toks = np.frombuffer(prompt.encode("utf-8"), np.uint8)
+    if toks.size == 0:
+        raise ValueError("prompt must be non-empty")
+    out = generate(model, variables, toks[None].astype(np.int32), n_new,
+                   temperature=temperature, rng=jax.random.PRNGKey(seed))
+    new = np.asarray(out)[0, toks.size:]
+    return bytes(np.clip(new, 0, 255).astype(np.uint8)).decode(
+        "utf-8", errors="replace")
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="tpunet LM text generation")
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--prompt", default="The ")
+    p.add_argument("--tokens", type=int, default=128,
+                   help="number of new tokens to generate")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; >0 samples softmax(logits/T)")
+    p.add_argument("--seed", type=int, default=0)
+    # Architecture of the trained checkpoint (must match training).
+    p.add_argument("--vit-hidden", type=int, default=192)
+    p.add_argument("--vit-depth", type=int, default=6)
+    p.add_argument("--vit-heads", type=int, default=3)
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--max-seq-len", type=int, default=1024)
+    args = p.parse_args(argv)
+
+    cfg = ModelConfig(name="lm", vit_hidden=args.vit_hidden,
+                      vit_depth=args.vit_depth, vit_heads=args.vit_heads,
+                      vocab_size=args.vocab_size,
+                      max_seq_len=args.max_seq_len, dropout_rate=0.0)
+    if args.vocab_size == 256:
+        # Byte-level checkpoint (--dataset text_lm): the prompt IS text.
+        prompt_len = len(args.prompt.encode("utf-8"))
+    else:
+        # Other vocabs: the prompt is space-separated token ids.
+        try:
+            prompt_toks = [int(t) for t in args.prompt.split()]
+        except ValueError:
+            raise SystemExit(
+                f"--vocab-size {args.vocab_size} checkpoints take the "
+                f"prompt as space-separated token ids, e.g. "
+                f"--prompt '5 7 3'; got {args.prompt!r}")
+        if not prompt_toks:
+            raise SystemExit("--prompt must contain at least one token id")
+        bad = [t for t in prompt_toks if not 0 <= t < args.vocab_size]
+        if bad:
+            raise SystemExit(f"prompt token(s) {bad} outside "
+                             f"[0, {args.vocab_size})")
+        prompt_len = len(prompt_toks)
+    if prompt_len + args.tokens > cfg.max_seq_len:
+        raise SystemExit(f"prompt+tokens = {prompt_len + args.tokens} "
+                         f"exceeds --max-seq-len {cfg.max_seq_len}")
+    model, variables = load_lm(cfg, checkpoint_dir=args.checkpoint_dir)
+    if args.vocab_size == 256:
+        text = generate_text(model, variables, args.prompt, args.tokens,
+                             temperature=args.temperature, seed=args.seed)
+        print(args.prompt + text)
+    else:
+        toks = np.asarray(prompt_toks, np.int32)[None]
+        out = generate(model, variables, toks, args.tokens,
+                       temperature=args.temperature,
+                       rng=jax.random.PRNGKey(args.seed))
+        print(" ".join(str(t) for t in np.asarray(out)[0]))
+
+
+if __name__ == "__main__":
+    main()

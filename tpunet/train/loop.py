@@ -47,7 +47,7 @@ class Trainer:
             raise ValueError("batch size larger than training set")
 
         self.is_lm = cfg.model.name == "lm"
-        is_token_data = cfg.data.dataset == "synthetic_lm"
+        is_token_data = cfg.data.dataset in ("synthetic_lm", "text_lm")
         if self.is_lm != is_token_data:
             raise ValueError(
                 f"model {cfg.model.name!r} and dataset "
@@ -87,6 +87,12 @@ class Trainer:
             # eval/best-checkpoint would measure that forever.
             raise ValueError(f"ema_decay must be in [0, 1), got "
                              f"{cfg.optim.ema_decay}")
+        if not 0.0 <= cfg.optim.warmup_epochs < cfg.epochs:
+            # warmup >= the whole run would keep every step on the ramp
+            # (base LR never reached, cosine horizon collapses to 1).
+            raise ValueError(
+                f"warmup_epochs ({cfg.optim.warmup_epochs}) must be in "
+                f"[0, epochs={cfg.epochs})")
         if cfg.data.batch_size % accum:
             raise ValueError(
                 f"batch size {cfg.data.batch_size} is not divisible by "
@@ -235,10 +241,12 @@ class Trainer:
         cfg = self.cfg
         state = self.state
         if cfg.optim.ema_decay > 0:
-            # Evaluate the EMA weights (what the best-checkpoint saves).
-            # ema_params mirrors params shape-for-shape and shard-for-
-            # shard (tp.py FSDP_RULES), so in_shardings still match.
-            state = state.replace(params=state.ema_params)
+            # Evaluate the EMA weights + EMA BN stats as a pair (what
+            # the best-checkpoint saves). Both mirror their live trees
+            # shape-for-shape and shard-for-shard (tp.py FSDP_RULES),
+            # so in_shardings still match.
+            state = state.replace(params=state.ema_params,
+                                  batch_stats=state.ema_batch_stats)
         acc = None
         for bx, by, bm in eval_batches(
                 self.test_x, self.test_y,
@@ -311,12 +319,15 @@ class Trainer:
                 if test_m["accuracy"] > self.best_acc:
                     self.best_acc = test_m["accuracy"]
                     # With EMA on, the test accuracy was measured on the
-                    # EMA weights — save those (what inference loads).
+                    # EMA weights + EMA BN stats — save that pair (what
+                    # inference loads).
+                    ema_on = cfg.optim.ema_decay > 0
                     self.ckpt.save_best({
-                        "params": (self.state.ema_params
-                                   if cfg.optim.ema_decay > 0
+                        "params": (self.state.ema_params if ema_on
                                    else self.state.params),
-                        "batch_stats": self.state.batch_stats,
+                        "batch_stats": (self.state.ema_batch_stats
+                                        if ema_on
+                                        else self.state.batch_stats),
                     })
                 self.start_epoch = epoch
                 self.ckpt.save_state(epoch, self._payload())

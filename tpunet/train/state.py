@@ -24,11 +24,17 @@ from tpunet.models.convert import load_pretrained
 
 class TrainState(train_state.TrainState):
     """flax TrainState + BatchNorm running statistics + optional
-    parameter EMA (``ema_params`` is {} when ema_decay == 0; when
-    enabled, evaluation and the best-checkpoint use the EMA weights)."""
+    model-state EMA (both {} when ema_decay == 0). The EMA covers the
+    BN running statistics as well as the params — evaluating EMA
+    weights against live running stats would pair mismatched
+    normalization with the weights (the reason torch's swa_utils
+    requires an update_bn pass; timm's ModelEmaV2 EMAs the whole
+    state_dict, which is the scheme here). Evaluation and the
+    best-checkpoint use the EMA pair."""
 
     batch_stats: Any = None
     ema_params: Any = None
+    ema_batch_stats: Any = None
 
 
 def lr_schedule(cfg: OptimConfig, steps_per_epoch: int, epochs: int):
@@ -119,13 +125,16 @@ def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
                                     num_classes=model_cfg.num_classes)
     tx = make_optimizer(optim_cfg, steps_per_epoch, epochs)
     params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    ema_on = optim_cfg.ema_decay > 0
+    copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
     return TrainState.create(
         apply_fn=model.apply,
         params=params,
-        batch_stats=variables.get("batch_stats", {}),
-        # EMA starts AT the initial params (torch.optim.swa_utils
+        batch_stats=stats,
+        # EMA starts AT the initial state (torch.optim.swa_utils
         # convention); {} when disabled so the pytree stays minimal.
-        ema_params=(jax.tree_util.tree_map(jnp.array, params)
-                    if optim_cfg.ema_decay > 0 else {}),
+        ema_params=copy(params) if ema_on else {},
+        ema_batch_stats=copy(stats) if ema_on else {},
         tx=tx,
     )

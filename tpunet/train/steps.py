@@ -77,10 +77,16 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
     def finish(state, grads, stats):
         state = state.apply_gradients(grads=grads, batch_stats=stats)
         if ema_decay > 0:
-            # EMA tracks the POST-update params; eval/best-ckpt read it.
-            state = state.replace(ema_params=jax.tree_util.tree_map(
+            # EMA tracks the POST-update params AND the BN running
+            # stats (evaluating EMA weights against live stats would
+            # mismatch normalization); eval/best-ckpt read the pair.
+            ema = lambda old, new: jax.tree_util.tree_map(
                 lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
-                state.ema_params, state.params))
+                old, new)
+            state = state.replace(
+                ema_params=ema(state.ema_params, state.params),
+                ema_batch_stats=ema(state.ema_batch_stats,
+                                    state.batch_stats))
         return state
 
     def train_step(state: TrainState, x, y, rng):
