@@ -1,0 +1,104 @@
+"""Observability + failure detection: per-step logging (the
+log_every_steps knob), host-side LR lookup, and the non-finite-loss
+guard (SURVEY.md section 5: the reference has neither — stdout epoch
+lines are its only observability and a NaN run would burn its full
+walltime)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, OptimConfig, TrainConfig)
+from tpunet.train.loop import Trainer
+
+LM_CFG = ModelConfig(name="lm", vit_hidden=64, vit_depth=2, vit_heads=4,
+                     dropout_rate=0.0, dtype="float32", vocab_size=32,
+                     max_seq_len=64)
+
+
+def _cfg(**kw):
+    kw.setdefault("epochs", 1)
+    kw.setdefault("checkpoint",
+                  CheckpointConfig(save_best=False, save_last=False))
+    return TrainConfig(
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        synthetic_train_size=64, synthetic_test_size=16,
+                        seq_len=64, vocab_size=32),
+        model=LM_CFG,
+        optim=OptimConfig(learning_rate=3e-3),
+        mesh=MeshConfig(),
+        **kw,
+    )
+
+
+def test_log_every_steps_emits_step_lines(capsys):
+    trainer = Trainer(_cfg(log_every_steps=2))
+    try:
+        trainer.train_one_epoch(1)  # 4 steps -> lines at steps 2 and 4
+    finally:
+        trainer.close()
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.strip().startswith("step ")]
+    assert len(lines) == 2
+    assert "loss" in lines[0] and "lr 3.000e-03" in lines[0]
+    assert lines[1].strip().startswith("step 4")
+
+
+def test_default_logs_no_step_lines(capsys):
+    trainer = Trainer(_cfg())
+    try:
+        trainer.train_one_epoch(1)
+    finally:
+        trainer.close()
+    assert "step " not in capsys.readouterr().out
+
+
+def test_step_line_prints_the_lr_that_produced_the_loss(capsys):
+    """optax consumes the PRE-increment count: the first step runs at
+    schedule(0), so with a 4-step warmup its line must show lr 0."""
+    import dataclasses
+    cfg = _cfg(epochs=2, log_every_steps=1)
+    cfg = cfg.replace(optim=dataclasses.replace(
+        cfg.optim, schedule="constant", warmup_epochs=1.0))
+    trainer = Trainer(cfg)
+    try:
+        trainer.train_one_epoch(1)
+    finally:
+        trainer.close()
+    lines = [l.split() for l in capsys.readouterr().out.splitlines()
+             if l.strip().startswith("step ")]
+    assert lines[0][-1] == "0.000e+00"          # schedule(0)
+    assert lines[3][-1] == "2.250e-03"          # schedule(3) = 3/4 ramp
+
+
+def test_current_lr_follows_schedule():
+    import dataclasses
+    cfg = _cfg(epochs=2)
+    cfg = cfg.replace(optim=dataclasses.replace(
+        cfg.optim, schedule="constant", warmup_epochs=1.0))
+    trainer = Trainer(cfg)  # 4 steps/epoch; warmup spans epoch 1
+    try:
+        assert trainer.current_lr() == pytest.approx(0.0)
+        trainer.train_one_epoch(1)
+        # after 4 of 4 warmup steps the ramp is complete
+        assert trainer.current_lr() == pytest.approx(3e-3)
+    finally:
+        trainer.close()
+
+
+def test_nan_guard_raises_and_preserves_no_checkpoint(tmp_path):
+    cfg = _cfg(checkpoint=CheckpointConfig(
+        directory=str(tmp_path / "ck"), save_best=False, save_last=True))
+    trainer = Trainer(cfg)
+    try:
+        trainer.state = trainer.state.replace(
+            params=jax.tree_util.tree_map(
+                lambda p: p * jnp.nan, trainer.state.params))
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            trainer.train()
+        # the guard fired BEFORE save_state: no poisoned resume point
+        assert trainer.ckpt.latest_step() is None
+    finally:
+        trainer.close()

@@ -341,6 +341,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="tensor-parallel axis size")
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
     p.add_argument("--profile-dir", default=None)
+    p.add_argument("--log-every-steps", type=int, default=None,
+                   help="emit a step/loss/lr line every N steps (0 = "
+                        "per-epoch only, like the reference)")
     p.add_argument("--no-native-loader", action="store_true",
                    help="force the pure-numpy host batch path")
     p.add_argument("--pallas-depthwise", default=None,
@@ -441,4 +444,6 @@ def config_from_args(argv=None) -> TrainConfig:
         cfg = cfg.replace(seed=args.seed)
     if args.profile_dir is not None:
         cfg = cfg.replace(profile_dir=args.profile_dir)
+    if args.log_every_steps is not None:
+        cfg = cfg.replace(log_every_steps=args.log_every_steps)
     return cfg

@@ -92,6 +92,8 @@ def main(argv=None):
     if args.vocab_size == 256:
         # Byte-level checkpoint (--dataset text_lm): the prompt IS text.
         prompt_len = len(args.prompt.encode("utf-8"))
+        if prompt_len == 0:
+            raise SystemExit("--prompt must be non-empty")
     else:
         # Other vocabs: the prompt is space-separated token ids.
         try:

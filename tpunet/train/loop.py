@@ -25,7 +25,7 @@ from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
                              shard_host_batch)
 from tpunet.parallel.tp import rules_for, tree_shardings
 from tpunet.train import metrics as M
-from tpunet.train.state import create_train_state
+from tpunet.train.state import create_train_state, lr_schedule
 from tpunet.train.steps import (make_eval_step, make_lm_eval_step,
                                 make_lm_train_step, make_train_step)
 from tpunet.utils import Timer, epoch_line, log0
@@ -141,6 +141,7 @@ class Trainer:
                 self._prefetcher = native.NativePrefetcher(
                     self.train_x, self.train_y.astype(np.int32), local)
 
+        self._schedule = lr_schedule(cfg.optim, self.spe, cfg.epochs)
         self.ckpt = Checkpointer(cfg.checkpoint)
         self.guard = PreemptionGuard()
         self.global_step = 0
@@ -226,6 +227,7 @@ class Trainer:
 
     def train_one_epoch(self, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
+        every = cfg.log_every_steps
         acc = None
         for bx, by in self._epoch_batches(epoch):
             if self._stop_agreed():
@@ -235,7 +237,23 @@ class Trainer:
             self.state, m = self.train_step(self.state, gx, gy, rng)
             acc = m if acc is None else M.accumulate(acc, m)
             self.global_step += 1
+            if every and self.global_step % every == 0:
+                # Opt-in per-step line (forces a device sync for the
+                # metric values; per-epoch-only, like the reference,
+                # when log_every_steps == 0).
+                sm = M.summarize(m)
+                # The step just taken consumed optax's PRE-increment
+                # count, i.e. schedule(global_step - 1) — print the LR
+                # that actually produced this loss.
+                lr = float(self._schedule(self.global_step - 1))
+                log0(f"  step {self.global_step} "
+                     f"loss {sm['loss']:.4f} acc {sm['accuracy']:.4f} "
+                     f"lr {lr:.3e}")
         return M.summarize(acc if acc is not None else M.zeros_metrics())
+
+    def current_lr(self) -> float:
+        """The LR the NEXT step will use (host-side schedule lookup)."""
+        return float(self._schedule(self.global_step))
 
     def evaluate(self) -> Dict[str, float]:
         cfg = self.cfg
@@ -279,6 +297,17 @@ class Trainer:
             for epoch in range(self.start_epoch, cfg.epochs + 1):
                 timer = Timer()
                 train_m = self.train_one_epoch(epoch)
+                if not np.isfinite(train_m["loss"]):
+                    # Failure detection (SURVEY.md section 5: the
+                    # reference has none — a NaN run would burn its full
+                    # SLURM walltime producing garbage). Stop BEFORE
+                    # save_state so the resume chain keeps the last
+                    # finite epoch, not the poisoned weights.
+                    raise FloatingPointError(
+                        f"non-finite train loss ({train_m['loss']}) at "
+                        f"epoch {epoch}; the last completed checkpoint "
+                        f"is still finite — resume from it with a lower "
+                        f"--lr or with --clip-norm")
                 if self.guard.requested:
                     # Preempted mid-epoch: persist the advanced state,
                     # marked partial so --resume re-runs this epoch's
