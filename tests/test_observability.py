@@ -88,6 +88,11 @@ def test_current_lr_follows_schedule():
         trainer.close()
 
 
+def test_negative_log_every_steps_raises():
+    with pytest.raises(ValueError, match="log_every_steps"):
+        Trainer(_cfg(log_every_steps=-1))
+
+
 def test_nan_guard_raises_and_preserves_no_checkpoint(tmp_path):
     cfg = _cfg(checkpoint=CheckpointConfig(
         directory=str(tmp_path / "ck"), save_best=False, save_last=True))

@@ -129,60 +129,63 @@ def _as_i64p(a: np.ndarray):
 
 def gather_rows(src: np.ndarray, idx: np.ndarray,
                 n_threads: int = 4) -> np.ndarray:
-    """out[i] = src[idx[i]] over the leading axis (uint8 arrays).
+    """out[i] = src[idx[i]] over the leading axis, for ANY fixed-size
+    dtype — the C++ gather is a raw byte memcpy per row (row_bytes =
+    trailing-shape elements x itemsize), so uint8 images and int32
+    token sequences ride the same path.
 
     Multithreaded native memcpy when the library is available, else numpy
     fancy indexing — bit-identical either way.
     """
-    if src.dtype != np.uint8:
-        raise TypeError(f"gather_rows expects uint8 rows, got {src.dtype}")
     lib = _load()
     src = np.ascontiguousarray(src)
     if lib is None:
         return src[idx]
     idx = np.ascontiguousarray(idx, dtype=np.int64)
-    out = np.empty((len(idx),) + src.shape[1:], dtype=np.uint8)
-    row_bytes = int(np.prod(src.shape[1:], dtype=np.int64))
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.itemsize
     lib.tn_gather_rows(_as_u8p(src), _as_i64p(idx), len(idx), row_bytes,
                        _as_u8p(out), n_threads)
     return out
 
 
 class NativePrefetcher:
-    """Background-thread batch assembly over an in-RAM uint8 dataset.
+    """Background-thread batch assembly over an in-RAM dataset of any
+    fixed-size dtype (uint8 image rows, int32 token rows — the C++ side
+    moves raw bytes either way).
 
-    Owns references to ``images``/``labels`` for its lifetime (the C++
+    Owns references to ``rows``/``labels`` for its lifetime (the C++
     side reads their buffers directly, zero-copy).
     """
 
-    def __init__(self, images: np.ndarray, labels: np.ndarray,
+    def __init__(self, rows: np.ndarray, labels: np.ndarray,
                  local_batch: int, depth: int = 4, n_threads: int = 4):
         lib = _load()
         if lib is None:
             raise RuntimeError("native batcher unavailable")
-        if images.dtype != np.uint8:
-            raise TypeError(
-                f"NativePrefetcher expects uint8 images, got {images.dtype}")
         self._lib = lib
-        self.images = np.ascontiguousarray(images)
+        self.rows = np.ascontiguousarray(rows)
         self.labels = np.ascontiguousarray(labels, dtype=np.int32)
         self.local_batch = int(local_batch)
-        self.row_shape = self.images.shape[1:]
-        row_bytes = int(np.prod(self.row_shape, dtype=np.int64))
+        self.row_shape = self.rows.shape[1:]
+        self.row_dtype = self.rows.dtype
+        row_bytes = (int(np.prod(self.row_shape, dtype=np.int64))
+                     * self.rows.itemsize)
         self._handle = lib.tn_prefetcher_create(
-            _as_u8p(self.images), _as_i32p(self.labels), len(self.images),
+            _as_u8p(self.rows), _as_i32p(self.labels), len(self.rows),
             row_bytes, self.local_batch, depth, n_threads)
         self._idx: Optional[np.ndarray] = None   # keep alive for C++ reads
 
     def iter_epoch(self, idx: np.ndarray) -> Iterator[
             Tuple[np.ndarray, np.ndarray]]:
-        """Yield (images[local_batch, ...], labels) following ``idx``."""
+        """Yield (rows[local_batch, ...], labels) following ``idx``."""
         self._idx = np.ascontiguousarray(idx, dtype=np.int64)
         if self._lib.tn_prefetcher_start_epoch(
                 self._handle, _as_i64p(self._idx), len(self._idx)):
             raise IndexError("prefetcher index out of range for dataset")
         while True:
-            x = np.empty((self.local_batch,) + self.row_shape, np.uint8)
+            x = np.empty((self.local_batch,) + self.row_shape,
+                         self.row_dtype)
             y = np.empty((self.local_batch,), np.int32)
             if self._lib.tn_prefetcher_next(self._handle, _as_u8p(x),
                                             _as_i32p(y)):

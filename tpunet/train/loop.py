@@ -87,6 +87,9 @@ class Trainer:
             # eval/best-checkpoint would measure that forever.
             raise ValueError(f"ema_decay must be in [0, 1), got "
                              f"{cfg.optim.ema_decay}")
+        if cfg.log_every_steps < 0:
+            raise ValueError(f"log_every_steps must be >= 0, got "
+                             f"{cfg.log_every_steps}")
         if not 0.0 <= cfg.optim.warmup_epochs < cfg.epochs:
             # warmup >= the whole run would keep every step on the ramp
             # (base LR never reached, cosine horizon collapses to 1).
@@ -134,7 +137,9 @@ class Trainer:
             in_shardings=(state_sh, bsh, bsh, bsh))
 
         self._prefetcher = None
-        if cfg.data.native_loader and not self.is_lm:
+        if cfg.data.native_loader:
+            # The native gather moves raw bytes per row, so uint8 image
+            # rows and int32 token rows share the same path.
             from tpunet.data import native
             if native.available():
                 local = cfg.data.batch_size // jax.process_count()
@@ -302,7 +307,11 @@ class Trainer:
                     # reference has none — a NaN run would burn its full
                     # SLURM walltime producing garbage). Stop BEFORE
                     # save_state so the resume chain keeps the last
-                    # finite epoch, not the poisoned weights.
+                    # finite epoch, not the poisoned weights — and make
+                    # that checkpoint durable first (saves are async;
+                    # raising past an uncommitted save would break the
+                    # message's promise).
+                    self.ckpt.wait()
                     raise FloatingPointError(
                         f"non-finite train loss ({train_m['loss']}) at "
                         f"epoch {epoch}; the last completed checkpoint "

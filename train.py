@@ -50,10 +50,14 @@ def main(argv=None) -> int:
         sync_hosts("dataset-ready")
         trainer = Trainer(cfg)
 
-    trainer.train()
-    if cfg.profile_dir:
-        jax.profiler.stop_trace()
-    trainer.close()
+    try:
+        trainer.train()
+    finally:
+        # Runs on the NaN-guard/preemption-raise paths too: close the
+        # prefetcher + checkpointer and flush any profiler trace.
+        if cfg.profile_dir:
+            jax.profiler.stop_trace()
+        trainer.close()
     return 0
 
 
