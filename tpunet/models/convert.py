@@ -58,6 +58,23 @@ def _block_specs() -> Tuple[Tuple[str, int, bool], ...]:
     return tuple(specs)
 
 
+def _layout():
+    """The torchvision MobileNetV2 key layout, as (flax_path, conv_key,
+    bn_key) triples — the single source of truth walked by BOTH the
+    importer and the exporter, so the two can never silently diverge."""
+    yield ("stem",), "features.0.0", "features.0.1"
+    for name, fi, has_expand in _block_specs():
+        base = f"features.{fi}.conv"
+        if has_expand:
+            yield (name, "expand"), f"{base}.0.0", f"{base}.0.1"
+            yield (name, "depthwise"), f"{base}.1.0", f"{base}.1.1"
+            yield (name, "project"), f"{base}.2", f"{base}.3"
+        else:
+            yield (name, "depthwise"), f"{base}.0.0", f"{base}.0.1"
+            yield (name, "project"), f"{base}.1", f"{base}.2"
+    yield ("head",), "features.18.0", "features.18.1"
+
+
 def convert_torch_state_dict(
     state_dict: Mapping[str, object],
     num_classes: int = 10,
@@ -90,17 +107,8 @@ def convert_torch_state_dict(
             "var": jnp.asarray(_np(sd[f"{bn_key}.running_var"])),
         }
 
-    convbn(("stem",), "features.0.0", "features.0.1")
-    for name, fi, has_expand in _block_specs():
-        base = f"features.{fi}.conv"
-        if has_expand:
-            convbn((name, "expand"), f"{base}.0.0", f"{base}.0.1")
-            convbn((name, "depthwise"), f"{base}.1.0", f"{base}.1.1")
-            convbn((name, "project"), f"{base}.2", f"{base}.3")
-        else:
-            convbn((name, "depthwise"), f"{base}.0.0", f"{base}.0.1")
-            convbn((name, "project"), f"{base}.1", f"{base}.2")
-    convbn(("head",), "features.18.0", "features.18.1")
+    for flax_path, conv_key, bn_key in _layout():
+        convbn(flax_path, conv_key, bn_key)
 
     head_converted = False
     w = _np(sd["classifier.1.weight"])
@@ -131,6 +139,83 @@ def merge_pretrained(variables: Dict, params: Dict, stats: Dict,
     return {"params": new_params, "batch_stats": new_stats}
 
 
+def export_torch_state_dict(params: Dict, batch_stats: Dict) -> Dict[str, np.ndarray]:
+    """The inverse converter: Flax ``params``/``batch_stats`` -> a torch
+    state_dict in the torchvision MobileNetV2 key layout (including the
+    ``num_batches_tracked`` BN bookkeeping entries ``load_state_dict``
+    checks under strict=True). Round-trips bit-exactly with
+    :func:`convert_torch_state_dict`, so tpunet-trained weights load
+    straight into torchvision/the reference's serving stack."""
+    sd: Dict[str, np.ndarray] = {}
+
+    def putconvbn(flax_path: Tuple[str, ...], conv_key: str, bn_key: str):
+        node = params
+        for p in flax_path:
+            node = node[p]
+        # HWIO -> OIHW (inverse of _conv)
+        sd[f"{conv_key}.weight"] = np.asarray(
+            node["conv"]["kernel"], np.float32).transpose(3, 2, 0, 1)
+        sd[f"{bn_key}.weight"] = np.asarray(node["bn"]["scale"], np.float32)
+        sd[f"{bn_key}.bias"] = np.asarray(node["bn"]["bias"], np.float32)
+        snode = batch_stats
+        for p in flax_path:
+            snode = snode[p]
+        sd[f"{bn_key}.running_mean"] = np.asarray(snode["bn"]["mean"],
+                                                  np.float32)
+        sd[f"{bn_key}.running_var"] = np.asarray(snode["bn"]["var"],
+                                                 np.float32)
+        sd[f"{bn_key}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+    for flax_path, conv_key, bn_key in _layout():
+        putconvbn(flax_path, conv_key, bn_key)
+    sd["classifier.1.weight"] = np.asarray(
+        params["classifier"]["kernel"], np.float32).T
+    sd["classifier.1.bias"] = np.asarray(params["classifier"]["bias"],
+                                         np.float32)
+    return sd
+
+
+def main(argv=None):
+    """Export a trained best-checkpoint to a torch ``.pth``:
+
+        python -m tpunet.models.convert out.pth --checkpoint-dir ckpt
+    """
+    import argparse
+
+    import jax
+
+    from tpunet.ckpt import Checkpointer
+    from tpunet.config import CheckpointConfig, ModelConfig
+    from tpunet.models import create_model, init_variables
+
+    p = argparse.ArgumentParser(
+        description="export a tpunet MobileNetV2 checkpoint as a torch "
+                    "state_dict (.pth)")
+    p.add_argument("out", help="output .pth path")
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--width-mult", type=float, default=1.0)
+    p.add_argument("--num-classes", type=int, default=10)
+    args = p.parse_args(argv)
+
+    import torch  # local import: only the writer needs torch
+
+    model_cfg = ModelConfig(width_mult=args.width_mult,
+                            num_classes=args.num_classes)
+    model = create_model(model_cfg)
+    variables = init_variables(model, jax.random.PRNGKey(0), image_size=32)
+    ckpt = Checkpointer(CheckpointConfig(directory=args.checkpoint_dir))
+    best = ckpt.restore_best({"params": variables["params"],
+                              "batch_stats": variables["batch_stats"]})
+    if best is None:
+        raise SystemExit(f"no best checkpoint under {args.checkpoint_dir!r}")
+    sd = export_torch_state_dict(best["params"], best["batch_stats"])
+    # torch.tensor COPIES — from_numpy would alias possibly-read-only
+    # JAX-export buffers and torch warns/UB on those.
+    torch.save({k: torch.tensor(np.asarray(v)) for k, v in sd.items()},
+               args.out)
+    print(f"wrote {len(sd)} tensors to {args.out}")
+
+
 def load_pretrained(path: str, variables: Dict, num_classes: int = 10) -> Dict:
     """Load a torch ``.pth`` checkpoint and overlay it onto ``variables``.
 
@@ -147,3 +232,7 @@ def load_pretrained(path: str, variables: Dict, num_classes: int = 10) -> Dict:
                 break
     params, stats, head_ok = convert_torch_state_dict(obj, num_classes)
     return merge_pretrained(variables, params, stats, head_ok)
+
+
+if __name__ == "__main__":
+    main()

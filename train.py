@@ -53,11 +53,13 @@ def main(argv=None) -> int:
     try:
         trainer.train()
     finally:
-        # Runs on the NaN-guard/preemption-raise paths too: close the
-        # prefetcher + checkpointer and flush any profiler trace.
+        # Runs on the NaN-guard/preemption-raise paths too. Close the
+        # trainer FIRST (flushes in-flight async checkpoint saves, joins
+        # the prefetcher's C++ threads) so a failing profiler flush
+        # can't skip it.
+        trainer.close()
         if cfg.profile_dir:
             jax.profiler.stop_trace()
-        trainer.close()
     return 0
 
 
