@@ -195,3 +195,149 @@ def test_bfloat16_path_close_to_f32():
     assert out.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
                                rtol=0.05, atol=0.05)
+
+
+# ---------------------------------------------------------------- flash
+
+
+class TestFlashAttention:
+    """Pallas flash kernel vs the dense/blockwise reference, exercised
+    in interpret mode on CPU (same scheme as the depthwise kernel)."""
+
+    def _qkv(self, b=2, t=128, h=4, d=32, tk=None, seed=0):
+        rng = np.random.default_rng(seed)
+        shape_k = (b, tk or t, h, d)
+        q = rng.standard_normal((b, t, h, d)).astype(np.float32)
+        k = rng.standard_normal(shape_k).astype(np.float32)
+        v = rng.standard_normal(shape_k).astype(np.float32)
+        return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        from tpunet.ops.flash import flash_attention
+        q, k, v = self._qkv()
+        out = flash_attention(q, k, v, causal=causal, block_q=32,
+                              block_k=32, interpret=True)
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_odd_lengths_fall_back_to_divisor_blocks(self):
+        from tpunet.ops.flash import flash_attention
+        q, k, v = self._qkv(t=65, d=16)  # ViT-like: 65 tokens (cls+8x8)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cross_length_causal_offset(self):
+        """tq < tk (decode window): the tk - tq diagonal offset must
+        match dense_attention."""
+        from tpunet.ops.flash import flash_attention
+        q, k, v = self._qkv(t=32, tk=128)
+        out = flash_attention(q, k, v, causal=True, block_q=16,
+                              block_k=32, interpret=True)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradients_match_dense(self):
+        from tpunet.ops.flash import flash_attention
+        q, k, v = self._qkv(t=64, d=16)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=16, block_k=16,
+                                           interpret=True) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_dense):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_bf16_accumulates_in_f32(self):
+        from tpunet.ops.flash import flash_attention
+        q, k, v = self._qkv(t=64)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        out = flash_attention(qb, kb, vb, causal=True, block_q=32,
+                              block_k=32, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=0.05, atol=0.05)
+
+    def test_off_tpu_entry_falls_back_to_dense(self):
+        if jax.default_backend() == "tpu":
+            pytest.skip("on TPU the entry runs the real kernel")
+        from tpunet.ops.flash import flash_attention
+        q, k, v = self._qkv(t=32)
+        out = flash_attention(q, k, v, causal=True)  # interpret=None
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_degenerate_lengths_fall_back_to_dense(self):
+        """A prime length whose only divisors are tiny must not build a
+        near-1-row-block grid; the entry returns the dense path (same
+        policy as attention.py's _auto_block)."""
+        from tpunet.ops.flash import flash_attention
+        q, k, v = self._qkv(t=97, d=16)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_spmd_partitions_over_batch_and_heads(self):
+        """The custom_partitioning rule: under a (data, model) mesh with
+        batch- and head-sharded inputs the kernel runs per-shard (each
+        device's pallas_call sees 1/4 batch x 1/2 heads) and still
+        matches dense."""
+        from jax.sharding import NamedSharding
+        from tpunet.config import MeshConfig
+        from tpunet.ops.flash import flash_attention
+        from tpunet.parallel import make_mesh
+
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        q, k, v = self._qkv(b=4, t=64, h=4, d=16)
+        sh = NamedSharding(mesh, P("data", None, "model", None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        fn = jax.jit(functools.partial(flash_attention, causal=True,
+                                       block_q=32, block_k=32,
+                                       interpret=True))
+        out = fn(qs, ks, vs)
+        # (PartitionSpec trims trailing Nones)
+        assert out.sharding.spec == P("data", None, "model")
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_lm_trains_with_flash_config(self):
+        """attention='flash' wires through the model registry (dense
+        fallback on the CPU backend) and trains end-to-end."""
+        from tpunet.config import (CheckpointConfig, DataConfig,
+                                   MeshConfig, ModelConfig, OptimConfig,
+                                   TrainConfig)
+        from tpunet.train.loop import Trainer
+        cfg = TrainConfig(
+            epochs=1,
+            data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                            synthetic_train_size=32,
+                            synthetic_test_size=16, seq_len=64,
+                            vocab_size=32),
+            model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                              vit_heads=4, dropout_rate=0.0,
+                              dtype="float32", vocab_size=32,
+                              max_seq_len=64, attention="flash"),
+            optim=OptimConfig(learning_rate=3e-3),
+            mesh=MeshConfig(),
+            checkpoint=CheckpointConfig(save_best=False, save_last=False),
+        )
+        trainer = Trainer(cfg)
+        try:
+            m = trainer.train_one_epoch(1)
+            assert np.isfinite(m["loss"])
+        finally:
+            trainer.close()

@@ -95,11 +95,14 @@ class ModelConfig:
     vit_heads: int = 3
     vit_mlp_ratio: float = 4.0
     # Core attention implementation for attention models:
-    # dense | blockwise (chunked K/V, bounded memory) | ring
-    # (sequence-parallel K/V rotation over the mesh 'seq' axis) |
-    # ulysses (sequence-parallel via two all-to-alls, heads resharded).
+    # dense | blockwise (chunked K/V, bounded memory) | flash (Pallas
+    # TPU kernel: fused online softmax, scores stay in VMEM; dense
+    # fallback off-TPU) | ring (sequence-parallel K/V rotation over the
+    # mesh 'seq' axis) | ulysses (sequence-parallel via two
+    # all-to-alls, heads resharded).
     attention: str = "dense"
-    attention_block: int = 512        # K/V chunk for attention="blockwise"
+    # K/V chunk for attention="blockwise"; block_q/block_k for "flash".
+    attention_block: int = 512
     # Mixture-of-Experts (ViT family): 0 experts = dense MLPs. Experts
     # are sharded over the mesh 'model' axis (expert parallelism).
     moe_experts: int = 0
@@ -295,12 +298,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatches per step (vit_pp)")
     p.add_argument("--attention", default=None,
-                   choices=["dense", "blockwise", "ring", "ulysses"],
-                   help="core attention impl for ViT/LM models; 'ring' "
-                        "and 'ulysses' are sequence-parallel over the "
-                        "mesh 'seq' axis")
+                   choices=["dense", "blockwise", "flash", "ring",
+                            "ulysses"],
+                   help="core attention impl for ViT/LM models; 'flash' "
+                        "is the fused Pallas TPU kernel (dense fallback "
+                        "off-TPU); 'ring' and 'ulysses' are "
+                        "sequence-parallel over the mesh 'seq' axis")
     p.add_argument("--attention-block", type=int, default=None,
-                   help="K/V chunk size for --attention blockwise")
+                   help="K/V chunk size for --attention blockwise; "
+                        "block_q/block_k for --attention flash")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize encoder blocks (less activation "
                         "memory, ~1/3 more backward FLOPs)")

@@ -261,6 +261,12 @@ def make_attn_fn(cfg: ModelConfig, mesh=None, causal: bool = False) -> AttnFn:
         return functools.partial(blockwise_attention,
                                  block_size=cfg.attention_block,
                                  causal=causal)
+    if cfg.attention == "flash":
+        from tpunet.ops.flash import flash_attention
+        return functools.partial(flash_attention,
+                                 block_q=cfg.attention_block,
+                                 block_k=cfg.attention_block,
+                                 causal=causal)
     if cfg.attention == "ring":
         if mesh is None:
             raise ValueError("attention='ring' requires a mesh")

@@ -4,25 +4,30 @@ Two families live here:
 
 - ``depthwise``: Pallas TPU kernel for the 3x3 depthwise convolution —
   the VPU-bound hot op of MobileNetV2 (9 multiply-adds per output
-  element with no contraction to feed the MXU; the one place a
-  hand-written kernel beats XLA's generic conv emitter).
+  element with no contraction to feed the MXU). Honest measurement:
+  XLA's fused conv pipeline beats it end-to-end, so it is off by
+  default and kept as the worked VPU-kernel example.
 - ``attention``: dense / blockwise / ring / Ulysses attention. Ring
   (K/V shards rotate over a mesh axis via ppermute with online-softmax
   accumulation) and Ulysses (all-to-all head resharding around a
   blockwise core) are the sequence-parallel primitives backing
   long-context support in the attention-based model families.
+- ``flash``: Pallas TPU flash-attention kernel — the fused MXU form of
+  the same online-softmax math (scores never leave VMEM).
 """
 
 from tpunet.ops.attention import (blockwise_attention, dense_attention,
                                   ring_attention, ring_self_attention,
                                   ulysses_attention, ulysses_self_attention)
 from tpunet.ops.depthwise import depthwise_conv3x3, depthwise_conv3x3_reference
+from tpunet.ops.flash import flash_attention
 
 __all__ = [
     "blockwise_attention",
     "dense_attention",
     "depthwise_conv3x3",
     "depthwise_conv3x3_reference",
+    "flash_attention",
     "ring_attention",
     "ring_self_attention",
     "ulysses_attention",

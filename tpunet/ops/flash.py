@@ -1,0 +1,272 @@
+"""Pallas TPU kernel: flash attention (fused online-softmax attention).
+
+The pure-JAX attention family (tpunet/ops/attention.py) bounds MEMORY
+via lax.scan online softmax, but XLA still materializes each [bq, Tk]
+score block in HBM between the two einsums. This kernel fuses
+scores -> online softmax -> weighted values into one VMEM-resident
+program per (batch, head, q-block): scores never leave VMEM, the two
+matmuls hit the MXU back-to-back, and the running (m, l, acc) state
+lives in scratch that persists across the sequential k-block grid axis
+(the standard TPU FlashAttention schedule).
+
+Design notes:
+- Grid (B, H, nq, nk); TPU iterates the LAST axis sequentially on one
+  core, so VMEM scratch carries the online-softmax state across k
+  blocks; @pl.when(k==0) initializes, @pl.when(k==nk-1) finalizes.
+- m/l scratch is (bq, 128): Mosaic wants the lane dim, values are
+  broadcast across it and read back as [:, :1].
+- Causal masking uses the same "explicitly zero masked probabilities"
+  convention as tpunet/ops/attention.py (fully-masked rows emit zeros,
+  not uniform attention).
+- float32 accumulation regardless of compute dtype (MXU-native bf16 in,
+  f32 out of the dot).
+- Backward: jax.custom_vjp whose bwd re-runs the BLOCKWISE reference
+  through jax.vjp — O(T x block) memory and bit-agreement with the
+  tested pure-JAX math; writing the flash backward kernel is the next
+  optimization, not a correctness need.
+- Off-TPU the public entry falls back to dense_attention (the Pallas
+  interpreter is far too slow for a hot path); tests exercise the real
+  kernel body on CPU with interpret=True, the same scheme as
+  tpunet/ops/depthwise.py.
+
+Measured on a real TPU v5e chip (B=4, T=4096, H=8, D=64, causal,
+bfloat16; synchronized by fetching a data-dependent output element):
+flash 13.0 ms/call vs dense 25.6 ms vs blockwise 17.1 ms — 1.97x over
+XLA's dense emitter, 1.31x over the scan-based blockwise path, forward
+only (the backward is the blockwise reference either way). Of that,
+the causal block-skip (@pl.when around both dots for fully-future k
+blocks) is worth ~8% (skipped blocks still pay their grid step and k/v
+block copies — restricting the grid itself is the next step) and
+keeping the dots in bf16 another ~4%.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tpunet.ops.attention import (_NEG_INF, blockwise_attention,
+                                  dense_attention)
+
+
+def _divisor_block(t: int, cap: int) -> int:
+    """Largest divisor of ``t`` that is <= cap — any length gets a valid
+    block (degenerate lengths like primes degrade toward one row per
+    block rather than failing)."""
+    return next(b for b in range(min(cap, t), 0, -1) if t % b == 0)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            scale: float, causal: bool, bq: int, bk: int, nk: int,
+            tq: int, tk: int):
+    qi = pl.program_id(2)     # program ids are hoisted out of the
+    ki = pl.program_id(3)     # pl.when bodies (cond sub-traces cannot
+                              # bind pallas primitives in interpret mode)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: skip BOTH MXU dots for k blocks that lie entirely in the
+    # future of this q block (they would only add zeros) — for tq == tk
+    # self-attention that is ~half of all grid steps.
+    if causal:
+        needed = (qi + 1) * bq - 1 + (tk - tq) >= ki * bk
+    else:
+        needed = True
+
+    @pl.when(needed)
+    def _compute():
+        # Dots run in the INPUT dtype with f32 accumulation (bf16 MXU
+        # throughput; attention.py's einsums use the same convention).
+        q = q_ref[0, 0]                            # [bq, D]
+        k = k_ref[0, 0]                            # [bk, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = None
+        if causal:
+            # Global positions; the tk - tq offset matches
+            # dense_attention's convention for decode windows.
+            qpos = (qi * bq
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+            kpos = (ki * bk
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
+            mask = qpos + (tk - tq) >= kpos
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        if mask is not None:
+            # Fully-masked ROWS keep m at the init floor; exp(s - m)
+            # there is 1, so zero the masked probabilities explicitly
+            # (same convention as attention.py's _block_update).
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)             # [bq, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool, scale: float,
+                    block_q: int, block_k: int,
+                    interpret: bool) -> jax.Array:
+    """q [B,Tq,H,D], k/v [B,Tk,H,D] -> [B,Tq,H,D]."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bq = _divisor_block(tq, block_q)
+    bk = _divisor_block(tk, block_k)
+    nq, nk = tq // bq, tk // bk
+
+    qt = q.swapaxes(1, 2)                          # [B, H, Tq, D]
+    kt = k.swapaxes(1, 2)
+    vt = v.swapaxes(1, 2)
+    kern = functools.partial(_kernel, scale=scale, causal=causal,
+                             bq=bq, bk=bk, nk=nk, tq=tq, tk=tk)
+    out = pl.pallas_call(
+        kern,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),    # running max m
+            pltpu.VMEM((bq, 128), jnp.float32),    # running normalizer l
+            pltpu.VMEM((bq, d), jnp.float32),      # un-normalized acc
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.swapaxes(1, 2)                      # back to BTHD
+
+
+# ---------------------------------------------------------------------------
+# SPMD partitioning: a pallas_call is opaque to GSPMD, so without a rule
+# the partitioner would all-gather the sharded batch onto every device
+# (the same issue tpunet/ops/depthwise.py solves). Flash attention is
+# trivially parallel over batch and heads (the grid's first two axes);
+# seq and head_dim must stay replicated per shard.
+# ---------------------------------------------------------------------------
+
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _flash_spec(arg_shapes) -> P:
+    sh = arg_shapes[0].sharding
+    qs = list(sh.spec) if isinstance(sh, NamedSharding) else []
+    qs += [None] * (4 - len(qs))
+    return P(qs[0], None, qs[2], None)   # batch/head shardable
+
+
+def _infer(causal, scale, block_q, block_k, interpret, mesh, arg_shapes,
+           result_shape):
+    return NamedSharding(mesh, _flash_spec(arg_shapes))
+
+
+def _partition(causal, scale, block_q, block_k, interpret, mesh,
+               arg_shapes, result_shape):
+    spec = _flash_spec(arg_shapes)
+    sharding = NamedSharding(mesh, spec)
+
+    def lower_fn(q, k, v):
+        return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+
+    return mesh, lower_fn, sharding, (sharding,) * 3
+
+
+_partitioned = custom_partitioning(_pallas_forward,
+                                   static_argnums=(3, 4, 5, 6, 7))
+_partitioned.def_partition(
+    partition=_partition,
+    infer_sharding_from_operands=_infer,
+    sharding_rule="b tq h d, b tk h d, b tk h d -> b tq h d",
+    # Shardy wants these sorted by factor introduction order
+    # (b, tq, h, d from q, then tk from k).
+    need_replication_factors=("tq", "d", "tk"),
+)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _partitioned(q, k, v, causal, scale, block_q, block_k,
+                        interpret)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, scale, block_q, block_k,
+                  interpret), (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+    # Blockwise reference backward: O(T x block) memory, exactly the
+    # tested pure-JAX math (attention.py). A flash backward kernel is
+    # future perf work, not a correctness requirement.
+    q, k, v = res
+    bk = _divisor_block(k.shape[1], block_k)
+    _, vjp = jax.vjp(
+        lambda qq, kk, vv: blockwise_attention(
+            qq, kk, vv, block_size=bk, causal=causal, scale=scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 512,
+                    block_k: int = 512,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused flash attention, BTHD layout, drop-in for dense_attention.
+
+    On TPU the Pallas kernel runs; off-TPU the default is the XLA dense
+    reference (pass ``interpret=True`` to exercise the kernel in tests).
+    Blocks clamp to the largest divisor of the sequence length <= the
+    requested size, so any length works (degenerate lengths fall back
+    to one block).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    tq, tk = q.shape[1], k.shape[1]
+    bq = _divisor_block(tq, block_q)
+    bk = _divisor_block(tk, block_k)
+    if (bq < 64 and bq < min(block_q, tq)) or \
+            (bk < 64 and bk < min(block_k, tk)):
+        # Degenerate lengths (primes etc.) whose only divisors are tiny:
+        # a grid of near-1-row blocks would serialize the contraction —
+        # fall back to one dense pass instead, the same policy as
+        # attention.py's _auto_block. (An explicitly requested small
+        # block is honored: tests drive the kernel with block 16/32.)
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return dense_attention(q, k, v, causal=causal, scale=scale)
+        interpret = False
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -53,13 +53,14 @@ def main(argv=None) -> int:
     try:
         trainer.train()
     finally:
-        # Runs on the NaN-guard/preemption-raise paths too. Close the
-        # trainer FIRST (flushes in-flight async checkpoint saves, joins
-        # the prefetcher's C++ threads) so a failing profiler flush
-        # can't skip it.
-        trainer.close()
-        if cfg.profile_dir:
-            jax.profiler.stop_trace()
+        # Runs on the NaN-guard/preemption-raise paths too; the nested
+        # finally makes each cleanup independent — a failing checkpoint
+        # flush in close() cannot skip the profiler flush or vice versa.
+        try:
+            trainer.close()
+        finally:
+            if cfg.profile_dir:
+                jax.profiler.stop_trace()
     return 0
 
 
