@@ -60,3 +60,62 @@ def test_train_cli_end_to_end(tmp_path):
     assert any(l.startswith("Best test accuracy:") for l in lines)
     assert any(l.startswith("Total training time:") for l in lines)
     assert (tmp_path / "ck" / "state").is_dir()
+
+
+def test_eval_only_flag_parses():
+    cfg = config_from_args(["--eval-only"])
+    assert cfg.eval_only
+
+
+def test_eval_only_evaluates_best_checkpoint(tmp_path):
+    """--eval-only on a trained dir reproduces the best test accuracy
+    without training; on an empty dir it raises cleanly."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.train.loop import Trainer
+
+    def cfg(**kw):
+        return TrainConfig(
+            epochs=1,
+            data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                            synthetic_train_size=32,
+                            synthetic_test_size=16, seq_len=32,
+                            vocab_size=32),
+            model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                              vit_heads=4, dropout_rate=0.0,
+                              dtype="float32", vocab_size=32,
+                              max_seq_len=32),
+            optim=OptimConfig(learning_rate=3e-3),
+            mesh=MeshConfig(),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "ck"),
+                                        save_last=False),
+            **kw,
+        )
+
+    trainer = Trainer(cfg())
+    try:
+        history = trainer.train()
+        trained_acc = history[-1]["test_accuracy"]
+    finally:
+        trainer.close()
+
+    ev = Trainer(cfg(eval_only=True))
+    try:
+        m = ev.evaluate_checkpoint()
+        assert m["accuracy"] == _pytest.approx(trained_acc, abs=1e-6)
+    finally:
+        ev.close()
+
+    empty = Trainer(dataclasses.replace(
+        cfg(eval_only=True),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "nope"),
+                                    save_last=False)))
+    try:
+        with _pytest.raises(FileNotFoundError, match="no checkpoint"):
+            empty.evaluate_checkpoint()
+    finally:
+        empty.close()

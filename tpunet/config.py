@@ -218,6 +218,9 @@ class TrainConfig:
 
     epochs: int = 20                  # reference EPOCHS (:158)
     seed: int = 42                    # reference torch.manual_seed(42) (:58)
+    # Evaluate a saved checkpoint (best params if present, else the
+    # last full state) and exit — no training.
+    eval_only: bool = False
     log_every_steps: int = 0          # 0 -> per-epoch only, like the reference
     profile_dir: str = ""             # non-empty -> jax.profiler traces
     data: DataConfig = field(default_factory=DataConfig)
@@ -337,6 +340,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="train-set size when --dataset synthetic")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--eval-only", action="store_true",
+                   help="evaluate the saved checkpoint (best params if "
+                        "present, else the last full state) and exit")
     p.add_argument("--mesh-data", type=int, default=None)
     p.add_argument("--mesh-seq", type=int, default=None,
                    help="sequence-parallel axis size (ring/ulysses "
@@ -452,4 +458,6 @@ def config_from_args(argv=None) -> TrainConfig:
         cfg = cfg.replace(profile_dir=args.profile_dir)
     if args.log_every_steps is not None:
         cfg = cfg.replace(log_every_steps=args.log_every_steps)
+    if args.eval_only:
+        cfg = cfg.replace(eval_only=True)
     return cfg

@@ -137,7 +137,7 @@ class Trainer:
             in_shardings=(state_sh, bsh, bsh, bsh))
 
         self._prefetcher = None
-        if cfg.data.native_loader:
+        if cfg.data.native_loader and not cfg.eval_only:
             # The native gather moves raw bytes per row, so uint8 image
             # rows and int32 token rows share the same path.
             from tpunet.data import native
@@ -259,6 +259,30 @@ class Trainer:
     def current_lr(self) -> float:
         """The LR the NEXT step will use (host-side schedule lookup)."""
         return float(self._schedule(self.global_step))
+
+    def evaluate_checkpoint(self) -> Dict[str, float]:
+        """--eval-only: load the saved weights and run one evaluation
+        pass — the best-params checkpoint when present (what inference
+        serves), else the last full train state."""
+        best = self.ckpt.restore_best({
+            "params": self.state.params,
+            "batch_stats": self.state.batch_stats})
+        if best is not None:
+            kw = dict(params=best["params"],
+                      batch_stats=best["batch_stats"])
+            if self.cfg.optim.ema_decay > 0:
+                # the best checkpoint already holds the EMA pair, and
+                # evaluate() reads the ema_* fields when EMA is on
+                kw.update(ema_params=best["params"],
+                          ema_batch_stats=best["batch_stats"])
+            self.state = self.state.replace(**kw)
+        elif self.ckpt.latest_step() is not None:
+            self._try_resume()
+        else:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.cfg.checkpoint.directory!r} "
+                "(need best/ or state/ to --eval-only)")
+        return self.evaluate()
 
     def evaluate(self) -> Dict[str, float]:
         cfg = self.cfg

@@ -51,7 +51,12 @@ def main(argv=None) -> int:
         trainer = Trainer(cfg)
 
     try:
-        trainer.train()
+        if cfg.eval_only:
+            m = trainer.evaluate_checkpoint()
+            log0(f"Eval: Test Loss: {m['loss']:.4f} "
+                 f"Test Acc: {m['accuracy']:.4f}")
+        else:
+            trainer.train()
     finally:
         # Runs on the NaN-guard/preemption-raise paths too; the nested
         # finally makes each cleanup independent — a failing checkpoint
