@@ -103,6 +103,11 @@ class ModelConfig:
     attention: str = "dense"
     # K/V chunk for attention="blockwise"; block_q/block_k for "flash".
     attention_block: int = 512
+    # Local full-sequence core inside attention="ulysses": "auto"
+    # (flash kernel on TPU, blockwise scan elsewhere), or force
+    # "flash"/"blockwise" (the escape hatch if the kernel misbehaves
+    # on some shape).
+    attention_core: str = "auto"
     # Mixture-of-Experts (ViT family): 0 experts = dense MLPs. Experts
     # are sharded over the mesh 'model' axis (expert parallelism).
     moe_experts: int = 0
@@ -310,6 +315,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--attention-block", type=int, default=None,
                    help="K/V chunk size for --attention blockwise; "
                         "block_q/block_k for --attention flash")
+    p.add_argument("--attention-core", default=None,
+                   choices=["auto", "flash", "blockwise"],
+                   help="local core inside --attention ulysses: auto = "
+                        "flash kernel on TPU, blockwise elsewhere; "
+                        "force blockwise as the escape hatch")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize encoder blocks (less activation "
                         "memory, ~1/3 more backward FLOPs)")
@@ -405,6 +415,8 @@ def config_from_args(argv=None) -> TrainConfig:
         model = dataclasses.replace(model, attention=args.attention)
     if args.attention_block is not None:
         model = dataclasses.replace(model, attention_block=args.attention_block)
+    if args.attention_core is not None:
+        model = dataclasses.replace(model, attention_core=args.attention_core)
     if args.remat:
         model = dataclasses.replace(model, remat=True)
     if args.zero1:

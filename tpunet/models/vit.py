@@ -277,8 +277,9 @@ def make_attn_fn(cfg: ModelConfig, mesh=None, causal: bool = False) -> AttnFn:
         if mesh is None:
             raise ValueError("attention='ulysses' requires a mesh")
         from tpunet.ops import ulysses_self_attention
+        core = None if cfg.attention_core == "auto" else cfg.attention_core
         return functools.partial(ulysses_self_attention, mesh=mesh,
-                                 causal=causal)
+                                 causal=causal, core=core)
     raise ValueError(f"unknown attention {cfg.attention!r}")
 
 

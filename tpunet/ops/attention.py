@@ -234,19 +234,42 @@ def _auto_block(t: int) -> int:
     return b if b >= 64 else t
 
 
+def _local_full_attention(q, k, v, causal, scale, core: Optional[str]):
+    """The locally-dense full-sequence core used inside Ulysses.
+
+    ``core`` None resolves to the Pallas flash kernel on TPU (measured
+    1.31x the blockwise scan, tpunet/ops/flash.py) and the blockwise
+    scan elsewhere; "flash"/"blockwise" force a choice ("flash" off-TPU
+    runs the kernel in interpret mode — test use only)."""
+    if core is None:
+        core = "flash" if jax.default_backend() == "tpu" else "blockwise"
+    if core == "flash":
+        from tpunet.ops.flash import local_flash_attention
+        interpret = True if jax.default_backend() != "tpu" else None
+        return local_flash_attention(q, k, v, causal=causal, scale=scale,
+                                     interpret=interpret)
+    if core == "blockwise":
+        return blockwise_attention(q, k, v,
+                                   block_size=_auto_block(q.shape[1]),
+                                   causal=causal, scale=scale)
+    raise ValueError(f"unknown attention core {core!r}")
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str, *,
                       causal: bool = False,
-                      scale: Optional[float] = None) -> jax.Array:
+                      scale: Optional[float] = None,
+                      core: Optional[str] = None) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style),
     shard_map body: inputs arrive seq-sharded [B, T/s, H, D]; one
     all-to-all (q/k/v stacked, so it is a single collective) re-shards
-    heads instead ([B, T, H/s, D]), attention runs blockwise over the
-    FULL sequence per head group, and a second all-to-all restores seq
+    heads instead ([B, T, H/s, D]), attention runs over the FULL
+    sequence per head group (the flash kernel on TPU, the blockwise
+    scan elsewhere — ``core``), and a second all-to-all restores seq
     sharding. Two collectives total per call — fewer than the ring's
     per-step hops when heads divide the axis — at the cost of holding
-    full-T activations per head group (the scores themselves stay
-    O(T x block) via the blockwise core)."""
+    full-T activations per head group (the scores themselves stay in
+    VMEM / O(T x block))."""
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(
             f"ulysses_attention is self-attention only (q {q.shape}, "
@@ -257,15 +280,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"{q.shape[2]} heads not divisible by sequence axis {n}")
     if n == 1:
-        return blockwise_attention(q, k, v,
-                                   block_size=_auto_block(q.shape[1]),
-                                   causal=causal, scale=scale)
+        return _local_full_attention(q, k, v, causal, scale, core)
     # [3, B, T/s, H, D] -> [3, B, T, H/s, D]: split heads, concat seq.
     qkv = jax.lax.all_to_all(jnp.stack([q, k, v]), axis_name,
                              split_axis=3, concat_axis=2, tiled=True)
-    out = blockwise_attention(qkv[0], qkv[1], qkv[2],
-                              block_size=_auto_block(qkv.shape[2]),
-                              causal=causal, scale=scale)
+    out = _local_full_attention(qkv[0], qkv[1], qkv[2], causal, scale,
+                                core)
     # [B, T, H/s, D] -> [B, T/s, H, D]: split seq, concat heads.
     return jax.lax.all_to_all(out, axis_name, split_axis=1,
                               concat_axis=2, tiled=True)
@@ -277,7 +297,8 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            batch_axis: str = "data",
                            head_axis: Optional[str] = "model",
                            causal: bool = False,
-                           scale: Optional[float] = None) -> jax.Array:
+                           scale: Optional[float] = None,
+                           core: Optional[str] = None) -> jax.Array:
     """shard_map wrapper for ``ulysses_attention`` (mirror of
     ``ring_self_attention``, including pass-through tensor-parallel
     head sharding — local heads must still divide the seq axis)."""
@@ -286,7 +307,7 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(batch_axis, seq_axis, h_ax, None)
     fn = jax.shard_map(
         functools.partial(ulysses_attention, axis_name=seq_axis,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, core=core),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

@@ -223,6 +223,21 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
                   interpret), (q, k, v)
 
 
+# Shard-local variant: the same kernel WITHOUT the custom_partitioning
+# wrapper, for callers already inside shard_map (e.g. the Ulysses
+# sequence-parallel core) where every array is per-shard and GSPMD has
+# nothing left to partition.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_local(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+
+
+def _fwd_local(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_local(q, k, v, causal, scale, block_q, block_k,
+                        interpret), (q, k, v)
+
+
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
     # Blockwise reference backward: O(T x block) memory, exactly the
     # tested pure-JAX math (attention.py). A flash backward kernel is
@@ -237,22 +252,25 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_fwd, _bwd)
+_flash_local.defvjp(_fwd_local, _bwd)  # same residuals/backward math
 
 
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = False,
-                    scale: Optional[float] = None,
-                    block_q: int = 512,
-                    block_k: int = 512,
-                    interpret: Optional[bool] = None) -> jax.Array:
-    """Fused flash attention, BTHD layout, drop-in for dense_attention.
+def local_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                          causal: bool = False,
+                          scale: Optional[float] = None,
+                          block_q: int = 512,
+                          block_k: int = 512,
+                          interpret: Optional[bool] = None) -> jax.Array:
+    """flash_attention for use INSIDE shard_map bodies: per-shard
+    arrays, no custom_partitioning wrapper. Same fallbacks (dense for
+    degenerate lengths; dense off-TPU unless interpret=True)."""
+    return _entry(_flash_local, q, k, v, causal, scale, block_q, block_k,
+                  interpret)
 
-    On TPU the Pallas kernel runs; off-TPU the default is the XLA dense
-    reference (pass ``interpret=True`` to exercise the kernel in tests).
-    Blocks clamp to the largest divisor of the sequence length <= the
-    requested size, so any length works (degenerate lengths fall back
-    to one block).
-    """
+
+def _entry(prim, q, k, v, causal, scale, block_q, block_k, interpret):
+    """Shared entry prologue for both public wrappers: scale default,
+    degenerate-length dense fallback, off-TPU/interpret resolution."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     tq, tk = q.shape[1], k.shape[1]
     bq = _divisor_block(tq, block_q)
@@ -269,4 +287,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         if jax.default_backend() != "tpu":
             return dense_attention(q, k, v, causal=causal, scale=scale)
         interpret = False
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return prim(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 512,
+                    block_k: int = 512,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused flash attention, BTHD layout, drop-in for dense_attention.
+
+    On TPU the Pallas kernel runs; off-TPU the default is the XLA dense
+    reference (pass ``interpret=True`` to exercise the kernel in tests).
+    Blocks clamp to the largest divisor of the sequence length <= the
+    requested size, so any length works (degenerate lengths fall back
+    to a dense pass).
+    """
+    return _entry(_flash, q, k, v, causal, scale, block_q, block_k,
+                  interpret)
