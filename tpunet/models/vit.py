@@ -279,7 +279,8 @@ def make_attn_fn(cfg: ModelConfig, mesh=None, causal: bool = False) -> AttnFn:
         from tpunet.ops import ulysses_self_attention
         core = None if cfg.attention_core == "auto" else cfg.attention_core
         return functools.partial(ulysses_self_attention, mesh=mesh,
-                                 causal=causal, core=core)
+                                 causal=causal, core=core,
+                                 block=cfg.attention_block)
     raise ValueError(f"unknown attention {cfg.attention!r}")
 
 

@@ -222,36 +222,43 @@ def _resolve_head_axis(mesh: Mesh, head_axis: Optional[str], heads: int,
     return head_axis
 
 
-def _auto_block(t: int) -> int:
+def _auto_block(t: int, cap: int = 512) -> int:
     """Block size for a length-``t`` blockwise pass: the largest divisor
-    of t that is <= 512, bounding score memory to O(t x 512). Lengths
-    whose only small divisors are degenerate (< 64, e.g. primes — a
-    t-step scan of 1-wide blocks) fall back to one dense pass instead;
-    that trades memory for not serializing the contraction."""
-    if t <= 512:
+    of t that is <= ``cap``, bounding score memory to O(t x cap).
+    Lengths whose only small divisors are degenerate (< 64, e.g. primes
+    — a t-step scan of 1-wide blocks) fall back to one dense pass
+    instead; that trades memory for not serializing the contraction."""
+    if t <= cap:
         return t
-    b = next(b for b in range(512, 0, -1) if t % b == 0)
+    b = next(b for b in range(cap, 0, -1) if t % b == 0)
     return b if b >= 64 else t
 
 
-def _local_full_attention(q, k, v, causal, scale, core: Optional[str]):
+def _local_full_attention(q, k, v, causal, scale, core: Optional[str],
+                          block: Optional[int] = None):
     """The locally-dense full-sequence core used inside Ulysses.
 
     ``core`` None resolves to the Pallas flash kernel on TPU (measured
     1.31x the blockwise scan, tpunet/ops/flash.py) and the blockwise
     scan elsewhere; "flash"/"blockwise" force a choice ("flash" off-TPU
-    runs the kernel in interpret mode — test use only)."""
+    runs the kernel in interpret mode — test use only). ``block``
+    overrides the kernel/scan block size (cfg.attention_block)."""
     if core is None:
         core = "flash" if jax.default_backend() == "tpu" else "blockwise"
     if core == "flash":
         from tpunet.ops.flash import local_flash_attention
         interpret = True if jax.default_backend() != "tpu" else None
+        b = block or 512
         return local_flash_attention(q, k, v, causal=causal, scale=scale,
+                                     block_q=b, block_k=b,
                                      interpret=interpret)
     if core == "blockwise":
-        return blockwise_attention(q, k, v,
-                                   block_size=_auto_block(q.shape[1]),
-                                   causal=causal, scale=scale)
+        # ``block`` is a CAP: the actual size is the largest divisor of
+        # the local length under it (an exact non-divisor would raise).
+        return blockwise_attention(
+            q, k, v,
+            block_size=_auto_block(q.shape[1], cap=block or 512),
+            causal=causal, scale=scale)
     raise ValueError(f"unknown attention core {core!r}")
 
 
@@ -259,7 +266,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str, *,
                       causal: bool = False,
                       scale: Optional[float] = None,
-                      core: Optional[str] = None) -> jax.Array:
+                      core: Optional[str] = None,
+                      block: Optional[int] = None) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style),
     shard_map body: inputs arrive seq-sharded [B, T/s, H, D]; one
     all-to-all (q/k/v stacked, so it is a single collective) re-shards
@@ -280,12 +288,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"{q.shape[2]} heads not divisible by sequence axis {n}")
     if n == 1:
-        return _local_full_attention(q, k, v, causal, scale, core)
+        return _local_full_attention(q, k, v, causal, scale, core, block)
     # [3, B, T/s, H, D] -> [3, B, T, H/s, D]: split heads, concat seq.
     qkv = jax.lax.all_to_all(jnp.stack([q, k, v]), axis_name,
                              split_axis=3, concat_axis=2, tiled=True)
     out = _local_full_attention(qkv[0], qkv[1], qkv[2], causal, scale,
-                                core)
+                                core, block)
     # [B, T, H/s, D] -> [B, T/s, H, D]: split seq, concat heads.
     return jax.lax.all_to_all(out, axis_name, split_axis=1,
                               concat_axis=2, tiled=True)
@@ -298,7 +306,8 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            head_axis: Optional[str] = "model",
                            causal: bool = False,
                            scale: Optional[float] = None,
-                           core: Optional[str] = None) -> jax.Array:
+                           core: Optional[str] = None,
+                           block: Optional[int] = None) -> jax.Array:
     """shard_map wrapper for ``ulysses_attention`` (mirror of
     ``ring_self_attention``, including pass-through tensor-parallel
     head sharding — local heads must still divide the seq axis)."""
@@ -307,7 +316,8 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(batch_axis, seq_axis, h_ax, None)
     fn = jax.shard_map(
         functools.partial(ulysses_attention, axis_name=seq_axis,
-                          causal=causal, scale=scale, core=core),
+                          causal=causal, scale=scale, core=core,
+                          block=block),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

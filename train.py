@@ -1,73 +1,11 @@
 #!/usr/bin/env python
-"""tpunet training entry point.
+"""tpunet training entry point (thin shim; the CLI lives in
+tpunet/main.py so the installed ``tpunet-train`` console script and
+``python train.py`` share one implementation)."""
 
-Replaces all three reference training scripts with one CLI over presets
-(SURVEY.md section 0):
-
-  python train.py --preset serial       # cifar10_serial_mobilenet_224.py
-  python train.py --preset single       # cifar10_128batch.py
-  python train.py --preset distributed  # cifar10_mpi_mobilenet_224.py
-
-Distributed runs need no mpirun/rank plumbing: launch the same command on
-every TPU-VM worker (see launch/run_pod.sh); process topology comes from
-the platform via jax.distributed.initialize.
-"""
-
-from __future__ import annotations
-
-import dataclasses
 import sys
 
-import jax
-
-from tpunet.config import config_from_args
-from tpunet.parallel import initialize_distributed, sync_hosts
-from tpunet.train.loop import Trainer
-from tpunet.utils import log0
-
-
-def main(argv=None) -> int:
-    initialize_distributed()
-    cfg = config_from_args(argv)
-    if cfg.profile_dir:
-        jax.profiler.start_trace(cfg.profile_dir)
-
-    n_proc = jax.process_count()
-    if n_proc > 1:
-        # Reference semantics: per-rank batch of 128 => global scales with
-        # world size (cifar10_mpi_mobilenet_224.py:117 + mpirun -np N).
-        cfg = cfg.replace(data=dataclasses.replace(
-            cfg.data, batch_size=cfg.data.batch_size * n_proc))
-    log0(f"JAX devices: {jax.device_count()} "
-         f"({jax.local_device_count()} local), processes: {n_proc}")
-
-    # Dataset fetch gate (reference rank-0 download + barrier, :93-102):
-    # process 0 touches the data dir first, other hosts wait.
-    if jax.process_index() == 0:
-        trainer = Trainer(cfg)
-        sync_hosts("dataset-ready")
-    else:
-        sync_hosts("dataset-ready")
-        trainer = Trainer(cfg)
-
-    try:
-        if cfg.eval_only:
-            m = trainer.evaluate_checkpoint()
-            log0(f"Eval: Test Loss: {m['loss']:.4f} "
-                 f"Test Acc: {m['accuracy']:.4f}")
-        else:
-            trainer.train()
-    finally:
-        # Runs on the NaN-guard/preemption-raise paths too; the nested
-        # finally makes each cleanup independent — a failing checkpoint
-        # flush in close() cannot skip the profiler flush or vice versa.
-        try:
-            trainer.close()
-        finally:
-            if cfg.profile_dir:
-                jax.profiler.stop_trace()
-    return 0
-
+from tpunet.main import main
 
 if __name__ == "__main__":
     sys.exit(main())
