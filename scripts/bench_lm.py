@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Long-context LM training throughput (tokens/sec) per attention impl.
+
+Measures the FULL jitted train step (forward + backward + Adam) of the
+decoder-only LM family at a long sequence length, comparing the
+attention cores (dense / blockwise / flash). Not driver-run (bench.py
+stays the reference-workload benchmark); this is the long-context perf
+evidence for the attention stack.
+
+    python scripts/bench_lm.py [--seq-len 2048] [--batch 8] [--depth 4]
+
+Synchronization: fetch a parameter element that is data-dependent on
+the last step's update (jax.block_until_ready on a small output can
+return before chained computation finishes on this platform — see
+bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--depth", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--attention", nargs="+",
+                   default=["dense", "blockwise", "flash"])
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--reps", type=int, default=2)
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block (the long-context "
+                        "recipe: without it, backward residuals are "
+                        "O(T^2) for every attention impl)")
+    args = p.parse_args()
+
+    from tpunet.config import ModelConfig, OptimConfig
+    from tpunet.models import create_model, init_variables
+    from tpunet.train.state import TrainState, make_optimizer
+    from tpunet.train.steps import make_lm_train_step
+    from tpunet.utils.prng import step_key
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, args.vocab, (args.batch, args.seq_len))
+    toks = jax.numpy.asarray(toks, jax.numpy.int32)
+    results = {}
+    for attn in args.attention:
+        mcfg = ModelConfig(
+            name="lm", vit_hidden=args.hidden, vit_depth=args.depth,
+            vit_heads=args.heads, vocab_size=args.vocab,
+            max_seq_len=args.seq_len, dropout_rate=0.0, attention=attn,
+            remat=args.remat)
+        model = create_model(mcfg)
+        variables = init_variables(model, jax.random.PRNGKey(0),
+                                   seq_len=args.seq_len)
+        state = TrainState.create(
+            apply_fn=model.apply, params=variables["params"],
+            batch_stats={}, ema_params={}, ema_batch_stats={},
+            tx=make_optimizer(OptimConfig(), 100, 1))
+        step = jax.jit(make_lm_train_step(OptimConfig(), mcfg),
+                       donate_argnums=0)
+
+        def sync(state):
+            jax.block_until_ready(state)
+            leaf = jax.tree_util.tree_leaves(state.params)[0]
+            return float(np.asarray(leaf.ravel()[0]))
+
+        print(f"# {attn}: compiling...", file=sys.stderr, flush=True)
+        for i in range(3):
+            state, m = step(state, toks, None, step_key(0, i))
+        sync(state)
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            for i in range(args.steps):
+                state, m = step(state, toks, None, step_key(0, i + 3))
+            sync(state)
+            best = min(best, (time.perf_counter() - t0) / args.steps)
+        tok_s = args.batch * args.seq_len / best
+        results[attn] = round(tok_s, 1)
+        print(f"# {attn}: {best * 1e3:.1f} ms/step, "
+              f"{tok_s:,.0f} tok/s", file=sys.stderr, flush=True)
+
+    print(json.dumps({
+        "metric": "lm_train_tokens_per_sec",
+        "config": {"batch": args.batch, "seq_len": args.seq_len,
+                   "hidden": args.hidden, "depth": args.depth,
+                   "heads": args.heads, "remat": args.remat,
+                   "platform": jax.devices()[0].platform},
+        "value": results,
+        "unit": "tok/s",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -16,7 +16,6 @@ the platform via jax.distributed.initialize.
 from __future__ import annotations
 
 import dataclasses
-import sys
 
 import jax
 

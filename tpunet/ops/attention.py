@@ -222,6 +222,13 @@ def _resolve_head_axis(mesh: Mesh, head_axis: Optional[str], heads: int,
     return head_axis
 
 
+def _divisor_block(t: int, cap: int) -> int:
+    """Largest divisor of ``t`` that is <= cap — honors explicitly tiny
+    caps (used when the caller chose the block size deliberately; the
+    flash kernel shares this)."""
+    return next(b for b in range(min(cap, t), 0, -1) if t % b == 0)
+
+
 def _auto_block(t: int, cap: int = 512) -> int:
     """Block size for a length-``t`` blockwise pass: the largest divisor
     of t that is <= ``cap``, bounding score memory to O(t x cap).
@@ -253,12 +260,14 @@ def _local_full_attention(q, k, v, causal, scale, core: Optional[str],
                                      block_q=b, block_k=b,
                                      interpret=interpret)
     if core == "blockwise":
-        # ``block`` is a CAP: the actual size is the largest divisor of
-        # the local length under it (an exact non-divisor would raise).
-        return blockwise_attention(
-            q, k, v,
-            block_size=_auto_block(q.shape[1], cap=block or 512),
-            causal=causal, scale=scale)
+        # ``block`` is a CAP clamped to a divisor of the local length.
+        # An EXPLICIT cap is honored even below _auto_block's 64 floor
+        # (the user chose it to bound memory); only auto-selection
+        # applies the degenerate-length dense fallback.
+        bs = (_divisor_block(q.shape[1], block) if block
+              else _auto_block(q.shape[1]))
+        return blockwise_attention(q, k, v, block_size=bs,
+                                   causal=causal, scale=scale)
     raise ValueError(f"unknown attention core {core!r}")
 
 

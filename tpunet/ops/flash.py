@@ -49,15 +49,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from tpunet.ops.attention import (_NEG_INF, blockwise_attention,
-                                  dense_attention)
-
-
-def _divisor_block(t: int, cap: int) -> int:
-    """Largest divisor of ``t`` that is <= cap — any length gets a valid
-    block (degenerate lengths like primes degrade toward one row per
-    block rather than failing)."""
-    return next(b for b in range(min(cap, t), 0, -1) if t % b == 0)
+from tpunet.ops.attention import (_NEG_INF, _divisor_block,
+                                  blockwise_attention, dense_attention)
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
