@@ -64,6 +64,12 @@ def main() -> None:
     rng = np.random.default_rng(0)
     toks = rng.integers(0, args.vocab, (args.batch, args.seq_len))
     toks = jax.numpy.asarray(toks, jax.numpy.int32)
+    if jax.default_backend() != "tpu" and "flash" in args.attention:
+        print("# WARNING: not on TPU — 'flash' falls back to dense "
+              "attention, so its column would just re-measure dense; "
+              "skipping it", file=sys.stderr, flush=True)
+        args.attention = [a for a in args.attention if a != "flash"]
+
     results = {}
     for attn in args.attention:
         mcfg = ModelConfig(

@@ -20,24 +20,29 @@ Design notes:
   not uniform attention).
 - float32 accumulation regardless of compute dtype (MXU-native bf16 in,
   f32 out of the dot).
-- Backward: jax.custom_vjp whose bwd re-runs the BLOCKWISE reference
-  through jax.vjp — O(T x block) memory and bit-agreement with the
-  tested pure-JAX math; writing the flash backward kernel is the next
-  optimization, not a correctness need.
+- Backward: two more Pallas kernels (the standard flash backward) —
+  probabilities are recomputed per block from the saved log-sum-exp, so
+  nothing O(Tq x Tk) touches HBM in either direction. dQ accumulates
+  over k blocks on grid (B,H,nq,nk); dK/dV accumulate over q blocks on
+  the transposed grid (B,H,nk,nq); delta = rowsum(dO * O) is plain XLA.
 - Off-TPU the public entry falls back to dense_attention (the Pallas
   interpreter is far too slow for a hot path); tests exercise the real
   kernel body on CPU with interpret=True, the same scheme as
   tpunet/ops/depthwise.py.
 
-Measured on a real TPU v5e chip (B=4, T=4096, H=8, D=64, causal,
-bfloat16; synchronized by fetching a data-dependent output element):
-flash 13.0 ms/call vs dense 25.6 ms vs blockwise 17.1 ms — 1.97x over
-XLA's dense emitter, 1.31x over the scan-based blockwise path, forward
-only (the backward is the blockwise reference either way). Of that,
-the causal block-skip (@pl.when around both dots for fully-future k
-blocks) is worth ~8% (skipped blocks still pay their grid step and k/v
-block copies — restricting the grid itself is the next step) and
-keeping the dots in bf16 another ~4%.
+Measured on a real TPU v5e chip, forward (B=4, T=4096, H=8, D=64,
+causal, bfloat16; synchronized by fetching a data-dependent output
+element): flash 10.7 ms/call vs dense 25.6 ms vs blockwise 17.1 ms —
+2.4x over XLA's dense emitter (forward-only calls skip the lse
+residual writes). Of that, the causal block-skip
+(@pl.when around both dots for fully-future k blocks) is worth ~8%
+(skipped blocks still pay their grid step and k/v block copies —
+restricting the grid itself is the next step) and keeping the dots in
+bf16 another ~4%. End-to-end LM training (fwd + bwd + Adam, the
+numbers that matter): 339k tok/s at T=2048 vs 161k dense, and 135k
+tok/s at T=8192+remat vs 28k blockwise — the flash backward kernels
+remove the O(T²) HBM traffic that binds the dense backward
+(scripts/bench_lm.py; full table in README.md).
 """
 
 from __future__ import annotations
@@ -50,12 +55,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from tpunet.ops.attention import (_NEG_INF, _divisor_block,
-                                  blockwise_attention, dense_attention)
+                                  dense_attention)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _kernel(q_ref, k_ref, v_ref, o_ref, *refs,
             scale: float, causal: bool, bq: int, bk: int, nk: int,
-            tq: int, tk: int):
+            tq: int, tk: int, with_lse: bool):
+    # The lse output exists only on the residual (training-forward)
+    # variant: the forward-only path skips its HBM writes entirely.
+    if with_lse:
+        lse_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        m_ref, l_ref, acc_ref = refs
     qi = pl.program_id(2)     # program ids are hoisted out of the
     ki = pl.program_id(3)     # pl.when bodies (cond sub-traces cannot
                               # bind pallas primitives in interpret mode)
@@ -116,13 +127,19 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        if with_lse:
+            # Log-sum-exp residual for the backward kernels: p can then
+            # be recomputed per block as exp(s - lse) without the
+            # running (m, l) state. Fully-masked rows keep the _NEG_INF
+            # floor. Broadcast across the 128-lane dim (Mosaic block
+            # constraint — the scheme of jax's stock TPU flash kernel).
+            lse = jnp.where(l == 0.0, _NEG_INF,
+                            m_ref[:, :1] + jnp.log(l_safe))
+            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool, scale: float,
-                    block_q: int, block_k: int,
-                    interpret: bool) -> jax.Array:
-    """q [B,Tq,H,D], k/v [B,Tk,H,D] -> [B,Tq,H,D]."""
+def _forward_impl(q, k, v, causal, scale, block_q, block_k, interpret,
+                  with_lse: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     b, tq, h, d = q.shape
@@ -135,8 +152,14 @@ def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array,
     kt = k.swapaxes(1, 2)
     vt = v.swapaxes(1, 2)
     kern = functools.partial(_kernel, scale=scale, causal=causal,
-                             bq=bq, bk=bk, nk=nk, tq=tq, tk=tk)
-    out = pl.pallas_call(
+                             bq=bq, bk=bk, nk=nk, tq=tq, tk=tk,
+                             with_lse=with_lse)
+    o_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0))
+    o_shape = jax.ShapeDtypeStruct((b, h, tq, d), q.dtype)
+    lse_spec = pl.BlockSpec((1, 1, bq, 128),
+                            lambda b, h, i, j: (b, h, i, 0))
+    lse_shape = jax.ShapeDtypeStruct((b, h, tq, 128), jnp.float32)
+    res = pl.pallas_call(
         kern,
         grid=(b, h, nq, nk),
         in_specs=[
@@ -144,9 +167,8 @@ def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+        out_specs=[o_spec, lse_spec] if with_lse else o_spec,
+        out_shape=[o_shape, lse_shape] if with_lse else o_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),    # running max m
             pltpu.VMEM((bq, 128), jnp.float32),    # running normalizer l
@@ -154,7 +176,169 @@ def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.swapaxes(1, 2)                      # back to BTHD
+    if with_lse:
+        out, lse = res
+        # out back to BTHD; lse squeezed to [B, H, Tq] (the kernel
+        # wrote identical values across the 128-lane dim).
+        return out.swapaxes(1, 2), lse[..., 0]
+    return res.swapaxes(1, 2)
+
+
+def _pallas_forward_res(q, k, v, causal, scale, block_q, block_k,
+                        interpret):
+    """-> (out [B,Tq,H,D], lse [B,H,Tq]) — the training forward."""
+    return _forward_impl(q, k, v, causal, scale, block_q, block_k,
+                         interpret, with_lse=True)
+
+
+def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    """-> out only; no lse HBM writes (the inference/eval forward)."""
+    return _forward_impl(q, k, v, causal, scale, block_q, block_k,
+                         interpret, with_lse=False)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (the standard two-pass flash backward): probabilities
+# are recomputed per block from the saved log-sum-exp, so nothing
+# O(Tq x Tk) ever touches HBM. delta = rowsum(dO * O) is plain XLA.
+#   dQ:    grid (B, H, nq, nk), accumulate over k blocks
+#   dK/dV: grid (B, H, nk, nq), accumulate over q blocks
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p_ds(q, k, v, do, lse, delta, scale, causal,
+                    qi, ki, bq, bk, tq, tk):
+    """Shared block math: p = exp(s - lse) (masked), dp = dO Vᵀ,
+    ds = p * (dp - delta) * scale. All f32; lse/delta are [bq, 1]."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = qpos + (tk - tq) >= kpos
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, bq, bk, nk, tq, tk):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = ((qi + 1) * bq - 1 + (tk - tq) >= ki * bk) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        k = k_ref[0, 0]
+        _, ds = _recompute_p_ds(q_ref[0, 0], k, v_ref[0, 0], do_ref[0, 0],
+                                lse_ref[0, 0, :, :1], delta_ref[0, 0, :, :1],
+                                scale, causal, qi, ki, bq, bk, tq, tk)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *,
+                scale, causal, bq, bk, nq, tq, tk):
+    ki, qi = pl.program_id(2), pl.program_id(3)   # note: k outer, q inner
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = ((qi + 1) * bq - 1 + (tk - tq) >= ki * bk) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        p, ds = _recompute_p_ds(q, k_ref[0, 0], v_ref[0, 0], do,
+                                lse_ref[0, 0, :, :1], delta_ref[0, 0, :, :1],
+                                scale, causal, qi, ki, bq, bk, tq, tk)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, do,
+                     causal: bool, scale: float,
+                     block_q: int, block_k: int, interpret: bool):
+    """-> (dq, dk, dv), all in their input layouts/dtypes."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bq = _divisor_block(tq, block_q)
+    bk = _divisor_block(tk, block_k)
+    nq, nk = tq // bq, tk // bk
+
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+    dot_ = do.swapaxes(1, 2)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1).swapaxes(1, 2)        # [B, H, Tq]
+    # Row vectors carry a 128-lane dim for Mosaic's block constraint
+    # (values identical across lanes; kernels read [:, :1]).
+    lse4 = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
+    delta4 = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0))
+    row_spec = pl.BlockSpec((1, 1, bq, 128), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, tq=tq, tk=tk),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse4, delta4)
+
+    # Same block roles, transposed grid: k block index is grid axis 2,
+    # q block index is the accumulated axis 3.
+    qi_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0))
+    rowi_spec = pl.BlockSpec((1, 1, bq, 128),
+                             lambda b, h, j, i: (b, h, i, 0))
+    kvj_spec = pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, tq=tq, tk=tk),
+        grid=(b, h, nk, nq),
+        in_specs=[qi_spec, kvj_spec, kvj_spec, qi_spec, rowi_spec,
+                  rowi_spec],
+        out_specs=[kvj_spec, kvj_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse4, delta4)
+    return (dq.swapaxes(1, 2), dk.swapaxes(1, 2), dv.swapaxes(1, 2))
 
 
 # ---------------------------------------------------------------------------
@@ -169,83 +353,130 @@ from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-def _flash_spec(arg_shapes) -> P:
+def _q_spec_of(arg_shapes) -> P:
     sh = arg_shapes[0].sharding
     qs = list(sh.spec) if isinstance(sh, NamedSharding) else []
     qs += [None] * (4 - len(qs))
     return P(qs[0], None, qs[2], None)   # batch/head shardable
 
 
-def _infer(causal, scale, block_q, block_k, interpret, mesh, arg_shapes,
-           result_shape):
-    return NamedSharding(mesh, _flash_spec(arg_shapes))
+def _shardings(mesh, spec):
+    """(4-D q/k/v/out sharding, 3-D lse/delta sharding) from the spec."""
+    return (NamedSharding(mesh, spec),
+            NamedSharding(mesh, P(spec[0], spec[2], None)))
 
 
-def _partition(causal, scale, block_q, block_k, interpret, mesh,
+def _infer_fwd(causal, scale, block_q, block_k, interpret, mesh,
                arg_shapes, result_shape):
-    spec = _flash_spec(arg_shapes)
-    sharding = NamedSharding(mesh, spec)
+    return _shardings(mesh, _q_spec_of(arg_shapes))[0]
+
+
+def _partition_fwd(causal, scale, block_q, block_k, interpret, mesh,
+                   arg_shapes, result_shape):
+    s4, _ = _shardings(mesh, _q_spec_of(arg_shapes))
 
     def lower_fn(q, k, v):
         return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
                                interpret)
 
-    return mesh, lower_fn, sharding, (sharding,) * 3
+    return mesh, lower_fn, s4, (s4,) * 3
 
 
-_partitioned = custom_partitioning(_pallas_forward,
-                                   static_argnums=(3, 4, 5, 6, 7))
+def _infer_res(causal, scale, block_q, block_k, interpret, mesh,
+               arg_shapes, result_shape):
+    s4, s3 = _shardings(mesh, _q_spec_of(arg_shapes))
+    return (s4, s3)
+
+
+def _partition_res(causal, scale, block_q, block_k, interpret, mesh,
+                   arg_shapes, result_shape):
+    s4, s3 = _shardings(mesh, _q_spec_of(arg_shapes))
+
+    def lower_fn(q, k, v):
+        return _pallas_forward_res(q, k, v, causal, scale, block_q,
+                                   block_k, interpret)
+
+    return mesh, lower_fn, (s4, s3), (s4,) * 3
+
+
+def _infer_bwd(causal, scale, block_q, block_k, interpret, mesh,
+               arg_shapes, result_shape):
+    s4, _ = _shardings(mesh, _q_spec_of(arg_shapes))
+    return (s4, s4, s4)
+
+
+def _partition_bwd(causal, scale, block_q, block_k, interpret, mesh,
+                   arg_shapes, result_shape):
+    s4, s3 = _shardings(mesh, _q_spec_of(arg_shapes))
+
+    def lower_fn(q, k, v, out, lse, do):
+        return _pallas_backward(q, k, v, out, lse, do, causal, scale,
+                                block_q, block_k, interpret)
+
+    return mesh, lower_fn, (s4, s4, s4), (s4, s4, s4, s4, s3, s4)
+
+
+_STATIC = dict(static_argnums=(3, 4, 5, 6, 7))
+# Shardy wants need_replication factors sorted by introduction order
+# (b, tq, h, d from q, then tk from k).
+_REPL = ("tq", "d", "tk")
+
+_partitioned = custom_partitioning(_pallas_forward, **_STATIC)
 _partitioned.def_partition(
-    partition=_partition,
-    infer_sharding_from_operands=_infer,
+    partition=_partition_fwd,
+    infer_sharding_from_operands=_infer_fwd,
     sharding_rule="b tq h d, b tk h d, b tk h d -> b tq h d",
-    # Shardy wants these sorted by factor introduction order
-    # (b, tq, h, d from q, then tk from k).
-    need_replication_factors=("tq", "d", "tk"),
+    need_replication_factors=_REPL,
+)
+
+_partitioned_res = custom_partitioning(_pallas_forward_res, **_STATIC)
+_partitioned_res.def_partition(
+    partition=_partition_res,
+    infer_sharding_from_operands=_infer_res,
+    sharding_rule="b tq h d, b tk h d, b tk h d -> b tq h d, b h tq",
+    need_replication_factors=_REPL,
+)
+
+_partitioned_bwd = custom_partitioning(
+    _pallas_backward, static_argnums=(6, 7, 8, 9, 10))
+_partitioned_bwd.def_partition(
+    partition=_partition_bwd,
+    infer_sharding_from_operands=_infer_bwd,
+    sharding_rule=("b tq h d, b tk h d, b tk h d, b tq h d, b h tq, "
+                   "b tq h d -> b tq h d, b tk h d, b tk h d"),
+    need_replication_factors=_REPL,
 )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _partitioned(q, k, v, causal, scale, block_q, block_k,
+def _make_flash(fwd_prim, res_prim, bwd_prim):
+    """custom_vjp wiring shared by the partitioned (top-level jit) and
+    shard-local (inside shard_map, where GSPMD has nothing left to
+    partition — the Ulysses core) variants: the flash forward saves
+    (q, k, v, out, lse) and the backward runs the two flash backward
+    kernels (dQ; dK/dV) — nothing O(Tq x Tk) in HBM either direction."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+    def f(q, k, v, causal, scale, block_q, block_k, interpret):
+        return fwd_prim(q, k, v, causal, scale, block_q, block_k,
                         interpret)
 
+    def fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+        out, lse = res_prim(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+        return out, (q, k, v, out, lse)
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, scale, block_q, block_k,
-                  interpret), (q, k, v)
+    def bwd(causal, scale, block_q, block_k, interpret, res, g):
+        q, k, v, out, lse = res
+        return bwd_prim(q, k, v, out, lse, g, causal, scale, block_q,
+                        block_k, interpret)
 
-
-# Shard-local variant: the same kernel WITHOUT the custom_partitioning
-# wrapper, for callers already inside shard_map (e.g. the Ulysses
-# sequence-parallel core) where every array is per-shard and GSPMD has
-# nothing left to partition.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_local(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
-                           interpret)
+    f.defvjp(fwd, bwd)
+    return f
 
 
-def _fwd_local(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_local(q, k, v, causal, scale, block_q, block_k,
-                        interpret), (q, k, v)
-
-
-def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # Blockwise reference backward: O(T x block) memory, exactly the
-    # tested pure-JAX math (attention.py). A flash backward kernel is
-    # future perf work, not a correctness requirement.
-    q, k, v = res
-    bk = _divisor_block(k.shape[1], block_k)
-    _, vjp = jax.vjp(
-        lambda qq, kk, vv: blockwise_attention(
-            qq, kk, vv, block_size=bk, causal=causal, scale=scale),
-        q, k, v)
-    return vjp(g)
-
-
-_flash.defvjp(_fwd, _bwd)
-_flash_local.defvjp(_fwd_local, _bwd)  # same residuals/backward math
+_flash = _make_flash(_partitioned, _partitioned_res, _partitioned_bwd)
+_flash_local = _make_flash(_pallas_forward, _pallas_forward_res,
+                           _pallas_backward)
 
 
 def local_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
