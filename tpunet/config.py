@@ -103,10 +103,10 @@ class ModelConfig:
     attention: str = "dense"
     # K/V chunk for attention="blockwise"; block_q/block_k for "flash".
     attention_block: int = 512
-    # Local full-sequence core inside attention="ulysses": "auto"
-    # (flash kernel on TPU, blockwise scan elsewhere), or force
-    # "flash"/"blockwise" (the escape hatch if the kernel misbehaves
-    # on some shape).
+    # Local core inside the sequence-parallel attentions ("ring" and
+    # "ulysses"): "auto" (flash kernel on TPU, the pure-JAX path
+    # elsewhere), or force "flash"/"blockwise" (the escape hatch if
+    # the kernel misbehaves on some shape).
     attention_core: str = "auto"
     # Mixture-of-Experts (ViT family): 0 experts = dense MLPs. Experts
     # are sharded over the mesh 'model' axis (expert parallelism).
@@ -317,9 +317,9 @@ def build_argparser() -> argparse.ArgumentParser:
                         "block_q/block_k for --attention flash")
     p.add_argument("--attention-core", default=None,
                    choices=["auto", "flash", "blockwise"],
-                   help="local core inside --attention ulysses: auto = "
-                        "flash kernel on TPU, blockwise elsewhere; "
-                        "force blockwise as the escape hatch")
+                   help="local core inside --attention ring/ulysses: "
+                        "auto = flash kernel on TPU, the pure-JAX path "
+                        "elsewhere; force blockwise as the escape hatch")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize encoder blocks (less activation "
                         "memory, ~1/3 more backward FLOPs)")

@@ -271,8 +271,9 @@ def make_attn_fn(cfg: ModelConfig, mesh=None, causal: bool = False) -> AttnFn:
         if mesh is None:
             raise ValueError("attention='ring' requires a mesh")
         from tpunet.ops import ring_self_attention
+        core = None if cfg.attention_core == "auto" else cfg.attention_core
         return functools.partial(ring_self_attention, mesh=mesh,
-                                 causal=causal)
+                                 causal=causal, core=core)
     if cfg.attention == "ulysses":
         if mesh is None:
             raise ValueError("attention='ulysses' requires a mesh")

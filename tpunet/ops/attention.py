@@ -163,14 +163,24 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, *,
                    causal: bool = False,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   core: Optional[str] = None) -> jax.Array:
     """Sequence-parallel attention over shard_map axis ``axis_name``.
 
     Call inside ``shard_map`` with q/k/v sharded on their seq dim over
     ``axis_name``. K/V shards rotate around the ring (``lax.ppermute``,
     one neighbor hop per step — ICI-friendly); each arriving block is
-    folded in with the online-softmax update. Exactly matches
-    ``dense_attention`` on the gathered arrays.
+    folded in. Exactly matches ``dense_attention`` on the gathered
+    arrays.
+
+    ``core`` (like Ulysses'): None = the flash kernel on TPU, the
+    pure-JAX online-softmax update elsewhere; "flash"/"blockwise"
+    force. The flash core computes each arriving block with the fused
+    kernel and folds it in by exact attention-state merging
+    (tpunet/ops/flash.py merge_attention_states); a ring step is one
+    of three static cases per source shard — fully past (unmasked
+    flash), the diagonal (causal flash), fully future (skip) — selected
+    with lax.cond on the rotating source index.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     n = jax.lax.psum(1, axis_name)
@@ -178,6 +188,24 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     tq = q.shape[1]
     tk = k.shape[1]
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    auto = core is None
+    if auto:
+        core = "flash" if jax.default_backend() == "tpu" else "blockwise"
+    if core not in ("flash", "blockwise"):
+        raise ValueError(f"unknown attention core {core!r}")
+    if core == "flash":
+        if not causal or tq == tk:
+            return _ring_flash(q, k, v, axis_name, causal, scale, n, my,
+                               perm)
+        if not auto:
+            raise ValueError(
+                f"core='flash' does not support causal cross-length "
+                f"rings (tq={tq} != tk={tk}: per-step masks are "
+                "arbitrary); use core='blockwise'")
+    # core == "blockwise" (the pure-JAX path), or auto-selected flash
+    # downgraded for a causal cross-length ring.
+
     q_pos = my * tq + jnp.arange(tq)
 
     def block_mask(step):
@@ -205,6 +233,55 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     m, l, acc = _block_update(state, q, k_last, v_last, scale,
                               block_mask(n - 1))
     return _finalize(m, l, acc, q.dtype)
+
+
+def _ring_flash(q, k, v, axis_name, causal, scale, n, my, perm):
+    """Flash-core ring body (see ring_attention): fused-kernel local
+    attention per arriving K/V shard + exact state merging."""
+    from tpunet.ops.flash import (local_flash_attention_state,
+                                  merge_attention_states)
+    b, tq, h, d = q.shape
+
+    def block_state(k_cur, v_cur, blk_causal: bool):
+        return local_flash_attention_state(q, k_cur, v_cur,
+                                           causal=blk_causal, scale=scale)
+
+    def fold(state, k_cur, v_cur, step):
+        if not causal:
+            return merge_attention_states(
+                state, block_state(k_cur, v_cur, False))
+        src = (my - step) % n
+        return jax.lax.cond(
+            src < my,
+            lambda args: merge_attention_states(
+                state, block_state(args[0], args[1], False)),
+            lambda args: jax.lax.cond(
+                src == my,
+                lambda a: merge_attention_states(
+                    state, block_state(a[0], a[1], True)),
+                lambda a: state,          # fully future: skip
+                args),
+            (k_cur, v_cur))
+
+    def body(carry, step):
+        state, k_cur, v_cur = carry
+        state = fold(state, k_cur, v_cur, step)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (state, k_nxt, v_nxt), None
+
+    # The merged-output accumulator stays float32 across all n folds
+    # (merge_attention_states keeps the carry's dtype) — one cast at
+    # the end, like the pure-JAX path's _finalize; a q.dtype carry
+    # would re-round bf16 at every ring step.
+    state = (jnp.zeros((b, tq, h, d), jnp.float32),
+             jnp.full((b, h, tq), _NEG_INF, jnp.float32))
+    k_last, v_last = k, v
+    if n > 1:
+        (state, k_last, v_last), _ = jax.lax.scan(
+            body, (state, k, v), jnp.arange(n - 1))
+    out, _ = fold(state, k_last, v_last, n - 1)
+    return out.astype(q.dtype)
 
 
 def _resolve_head_axis(mesh: Mesh, head_axis: Optional[str], heads: int,
@@ -338,7 +415,8 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         batch_axis: str = "data",
                         head_axis: Optional[str] = "model",
                         causal: bool = False,
-                        scale: Optional[float] = None) -> jax.Array:
+                        scale: Optional[float] = None,
+                        core: Optional[str] = None) -> jax.Array:
     """shard_map wrapper: global BTHD arrays in, ring attention inside.
 
     Batch dim sharded over ``batch_axis``, seq dim over ``seq_axis``.
@@ -351,7 +429,7 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(batch_axis, seq_axis, h_ax, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=seq_axis,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, core=core),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

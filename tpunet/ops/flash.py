@@ -206,10 +206,14 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p_ds(q, k, v, do, lse, delta, scale, causal,
+def _recompute_p_ds(q, k, v, do, lse, delta, glse, scale, causal,
                     qi, ki, bq, bk, tq, tk):
     """Shared block math: p = exp(s - lse) (masked), dp = dO Vᵀ,
-    ds = p * (dp - delta) * scale. All f32; lse/delta are [bq, 1]."""
+    ds = p * (dp - delta + glse) * scale. All f32; lse/delta/glse are
+    [bq, 1]. ``glse`` is the cotangent of the lse OUTPUT (d lse/d s is
+    exactly p, so it adds inside the parenthesis); zero for plain
+    attention, nonzero when attention-state merging consumed the lse
+    (the ring)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if causal:
@@ -222,12 +226,20 @@ def _recompute_p_ds(q, k, v, do, lse, delta, scale, causal,
         p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
+    ds = p * (dp - delta + glse) * scale
     return p, ds
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, bq, bk, nk, tq, tk):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+               scale, causal, bq, bk, nk, tq, tk, with_glse):
+    # glse is an input only when the lse output's cotangent is nonzero
+    # (the ring's state merging); plain attention skips its HBM reads.
+    if with_glse:
+        glse_ref, dq_ref, dq_scr = refs
+        glse = glse_ref[0, 0, :, :1]
+    else:
+        dq_ref, dq_scr = refs
+        glse = 0.0
     qi, ki = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -241,6 +253,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0]
         _, ds = _recompute_p_ds(q_ref[0, 0], k, v_ref[0, 0], do_ref[0, 0],
                                 lse_ref[0, 0, :, :1], delta_ref[0, 0, :, :1],
+                                glse,
                                 scale, causal, qi, ki, bq, bk, tq, tk)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -251,9 +264,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *,
-                scale, causal, bq, bk, nq, tq, tk):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                scale, causal, bq, bk, nq, tq, tk, with_glse):
+    if with_glse:
+        glse_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+        glse = glse_ref[0, 0, :, :1]
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
+        glse = 0.0
     ki, qi = pl.program_id(2), pl.program_id(3)   # note: k outer, q inner
 
     @pl.when(qi == 0)
@@ -269,6 +287,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0]
         p, ds = _recompute_p_ds(q, k_ref[0, 0], v_ref[0, 0], do,
                                 lse_ref[0, 0, :, :1], delta_ref[0, 0, :, :1],
+                                glse,
                                 scale, causal, qi, ki, bq, bk, tq, tk)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -285,8 +304,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _pallas_backward(q, k, v, out, lse, do,
                      causal: bool, scale: float,
-                     block_q: int, block_k: int, interpret: bool):
-    """-> (dq, dk, dv), all in their input layouts/dtypes."""
+                     block_q: int, block_k: int, interpret: bool,
+                     glse=None):
+    """-> (dq, dk, dv), all in their input layouts/dtypes. ``glse``
+    [B,H,Tq] is the lse output's cotangent — None (plain attention)
+    compiles kernels without the extra input."""
     from jax.experimental.pallas import tpu as pltpu
 
     b, tq, h, d = q.shape
@@ -294,6 +316,7 @@ def _pallas_backward(q, k, v, out, lse, do,
     bq = _divisor_block(tq, block_q)
     bk = _divisor_block(tk, block_k)
     nq, nk = tq // bq, tk // bk
+    with_glse = glse is not None
 
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
     dot_ = do.swapaxes(1, 2)
@@ -301,23 +324,26 @@ def _pallas_backward(q, k, v, out, lse, do,
                     axis=-1).swapaxes(1, 2)        # [B, H, Tq]
     # Row vectors carry a 128-lane dim for Mosaic's block constraint
     # (values identical across lanes; kernels read [:, :1]).
-    lse4 = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
-    delta4 = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
+    lane = lambda x: jnp.broadcast_to(x.astype(jnp.float32)[..., None],
+                                      x.shape + (128,))
+    rows = [lane(lse), lane(delta)] + ([lane(glse)] if with_glse else [])
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0))
     row_spec = pl.BlockSpec((1, 1, bq, 128), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0))
+    n_rows = len(rows)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, tq=tq, tk=tk),
+                          bq=bq, bk=bk, nk=nk, tq=tq, tk=tk,
+                          with_glse=with_glse),
         grid=(b, h, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec] + [row_spec] * n_rows,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot_, lse4, delta4)
+    )(qt, kt, vt, dot_, *rows)
 
     # Same block roles, transposed grid: k block index is grid axis 2,
     # q block index is the accumulated axis 3.
@@ -327,17 +353,18 @@ def _pallas_backward(q, k, v, out, lse, do,
     kvj_spec = pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, tq=tq, tk=tk),
+                          bq=bq, bk=bk, nq=nq, tq=tq, tk=tk,
+                          with_glse=with_glse),
         grid=(b, h, nk, nq),
-        in_specs=[qi_spec, kvj_spec, kvj_spec, qi_spec, rowi_spec,
-                  rowi_spec],
+        in_specs=[qi_spec, kvj_spec, kvj_spec, qi_spec]
+        + [rowi_spec] * n_rows,
         out_specs=[kvj_spec, kvj_spec],
         out_shape=[jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot_, lse4, delta4)
+    )(qt, kt, vt, dot_, *rows)
     return (dq.swapaxes(1, 2), dk.swapaxes(1, 2), dv.swapaxes(1, 2))
 
 
@@ -437,8 +464,16 @@ _partitioned_res.def_partition(
     need_replication_factors=_REPL,
 )
 
+def _pallas_backward_nog(q, k, v, out, lse, do, causal, scale, block_q,
+                         block_k, interpret):
+    """Fixed-arity wrapper for custom_partitioning (the glse=None
+    default of _pallas_backward would otherwise count as an operand)."""
+    return _pallas_backward(q, k, v, out, lse, do, causal, scale,
+                            block_q, block_k, interpret)
+
+
 _partitioned_bwd = custom_partitioning(
-    _pallas_backward, static_argnums=(6, 7, 8, 9, 10))
+    _pallas_backward_nog, static_argnums=(6, 7, 8, 9, 10))
 _partitioned_bwd.def_partition(
     partition=_partition_bwd,
     infer_sharding_from_operands=_infer_bwd,
@@ -467,6 +502,7 @@ def _make_flash(fwd_prim, res_prim, bwd_prim):
 
     def bwd(causal, scale, block_q, block_k, interpret, res, g):
         q, k, v, out, lse = res
+        # Plain attention exposes no lse downstream: no glse operand.
         return bwd_prim(q, k, v, out, lse, g, causal, scale, block_q,
                         block_k, interpret)
 
@@ -490,6 +526,75 @@ def local_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     degenerate lengths; dense off-TPU unless interpret=True)."""
     return _entry(_flash_local, q, k, v, causal, scale, block_q, block_k,
                   interpret)
+
+
+# Attention-STATE variant for the ring: returns (out, lse) so partial
+# results over different K/V blocks can be merged exactly
+# (merge_attention_states). Differentiable: built from the same
+# primitives, so the flash backward kernels serve it too.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_local_state(q, k, v, causal, scale, block_q, block_k,
+                       interpret):
+    return _pallas_forward_res(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+
+
+def _fwd_local_state(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _pallas_forward_res(q, k, v, causal, scale, block_q,
+                                   block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _bwd_local_state(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    go, glse = g
+    # The lse output IS consumed downstream (the ring's state-merge
+    # weights depend on it), so its cotangent carries real gradient:
+    # d lse / d s = p, folded into ds inside the kernels.
+    return _pallas_backward(q, k, v, out, lse, go, causal, scale,
+                            block_q, block_k, interpret, glse=glse)
+
+
+_flash_local_state.defvjp(_fwd_local_state, _bwd_local_state)
+
+
+def local_flash_attention_state(q, k, v, *, causal=False, scale=None,
+                                block_q: int = 512, block_k: int = 512,
+                                interpret: Optional[bool] = None):
+    """(out [B,Tq,H,D], lse [B,H,Tq]) over ONE K/V block — the ring
+    core. No dense fallback here: the ring needs the lse state, and a
+    shard's K/V block length is mesh-controlled (divisible), not
+    user-degenerate. Off-TPU runs in interpret mode."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_local_state(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+
+
+def merge_attention_states(state_a, state_b):
+    """Exactly combine two partial attention results computed over
+    disjoint K/V blocks: each state is (out [B,Tq,H,D] normalized,
+    lse [B,H,Tq]). With m = max(lse_a, lse_b) and w_x = exp(lse_x - m):
+    out = (w_a*out_a + w_b*out_b) / (w_a + w_b), lse = m + log(w_a+w_b)
+    — associative, so it carries through a lax.scan (the ring).
+    Fully-masked blocks arrive with lse = _NEG_INF and weight 0; rows
+    masked in BOTH emit zeros (the l == 0 convention of
+    tpunet/ops/attention.py)."""
+    oa, la = state_a
+    ob, lb = state_b
+    m = jnp.maximum(la, lb)                        # [B, H, Tq]
+    # Guard exp(_NEG_INF - _NEG_INF) = 1 on rows masked in both.
+    both_dead = m <= _NEG_INF
+    wa = jnp.where(both_dead, 0.0, jnp.exp(la - m))
+    wb = jnp.where(both_dead, 0.0, jnp.exp(lb - m))
+    denom = wa + wb
+    safe = jnp.where(denom == 0.0, 1.0, denom)
+    to_bthd = lambda w: w.transpose(0, 2, 1)[..., None]  # [B,Tq,H,1]
+    out = (to_bthd(wa) * oa.astype(jnp.float32)
+           + to_bthd(wb) * ob.astype(jnp.float32)) / to_bthd(safe)
+    lse = jnp.where(denom == 0.0, _NEG_INF, m + jnp.log(safe))
+    return out.astype(oa.dtype), lse
 
 
 def _entry(prim, q, k, v, causal, scale, block_q, block_k, interpret):
