@@ -240,3 +240,26 @@ def test_cli_flags():
     assert cfg.optim.warmup_epochs == 0.5
     assert cfg.optim.clip_norm == 1.0
     assert cfg.optim.ema_decay == 0.999
+
+
+def test_optimizer_cli_exposure():
+    from tpunet.config import config_from_args
+    cfg = config_from_args(["--optimizer", "adamw", "--weight-decay",
+                            "0.05", "--label-smoothing", "0.1",
+                            "--eval-batch-size", "256"])
+    assert cfg.optim.name == "adamw"
+    assert cfg.optim.weight_decay == 0.05
+    assert cfg.optim.label_smoothing == 0.1
+    assert cfg.data.eval_batch_size == 256
+
+
+def test_adamw_and_sgd_train():
+    for name, kw in (("adamw", dict(weight_decay=0.01)),
+                     ("sgd", {})):
+        trainer = Trainer(_lm_cfg(OptimConfig(name=name,
+                                              learning_rate=3e-3, **kw)))
+        try:
+            m = trainer.train_one_epoch(1)
+            assert np.isfinite(m["loss"]), name
+        finally:
+            trainer.close()

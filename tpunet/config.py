@@ -272,6 +272,14 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="global batch size")
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--optimizer", default=None,
+                   choices=["adam", "adamw", "sgd"],
+                   help="adam is the reference stack (:148); adamw "
+                        "activates --weight-decay; sgd uses momentum 0.9")
+    p.add_argument("--weight-decay", type=float, default=None)
+    p.add_argument("--label-smoothing", type=float, default=None)
+    p.add_argument("--eval-batch-size", type=int, default=None,
+                   help="global eval batch (default: --batch-size)")
     p.add_argument("--lr-schedule", default=None,
                    choices=["step", "cosine", "constant"],
                    help="step = the reference's StepLR(10, 0.1); cosine "
@@ -441,6 +449,16 @@ def config_from_args(argv=None) -> TrainConfig:
         model = dataclasses.replace(model, dtype=args.dtype)
     if args.lr is not None:
         optim = dataclasses.replace(optim, learning_rate=args.lr)
+    if args.optimizer is not None:
+        optim = dataclasses.replace(optim, name=args.optimizer)
+    if args.weight_decay is not None:
+        optim = dataclasses.replace(optim, weight_decay=args.weight_decay)
+    if args.label_smoothing is not None:
+        optim = dataclasses.replace(optim,
+                                    label_smoothing=args.label_smoothing)
+    if args.eval_batch_size is not None:
+        data = dataclasses.replace(data,
+                                   eval_batch_size=args.eval_batch_size)
     if args.lr_schedule is not None:
         optim = dataclasses.replace(optim, schedule=args.lr_schedule)
     if args.warmup_epochs is not None:
