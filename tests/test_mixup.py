@@ -1,0 +1,134 @@
+"""Mixup / CutMix: on-device batch mixing inside the jitted train step
+(beyond-parity; the reference's transform stack at :72-82 has neither)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, OptimConfig, TrainConfig)
+from tpunet.data.augment import mixup_cutmix
+from tpunet.train.loop import Trainer
+
+
+def _batch(b=8, h=16, w=16):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((b, h, w, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, b), jnp.int32)
+    return x, y
+
+
+def test_disabled_is_identity():
+    x, y = _batch()
+    out, yb, lam = mixup_cutmix(jax.random.PRNGKey(0), x, y, 0.0, 0.0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(yb), np.asarray(y))
+    assert float(lam) == 1.0
+
+
+def test_mixup_is_convex_combination():
+    x, y = _batch()
+    out, yb, lam = mixup_cutmix(jax.random.PRNGKey(1), x, y, 0.4, 0.0)
+    lam = float(lam)
+    assert 0.0 <= lam <= 1.0
+    # reconstruct: out = lam*x + (1-lam)*x[perm]; recover the pairing
+    # from the labels and verify exactly
+    perm_x = (np.asarray(out) - lam * np.asarray(x)) / max(1 - lam, 1e-9)
+    # every mixed row must be one of the original rows
+    xs = np.asarray(x)
+    for i in range(xs.shape[0]):
+        dists = np.abs(xs - perm_x[i]).mean(axis=(1, 2, 3))
+        assert dists.min() < 1e-4
+    # labels_b is a permutation of labels
+    assert sorted(np.asarray(yb).tolist()) == sorted(np.asarray(y).tolist())
+
+
+def test_cutmix_pixels_come_from_two_sources():
+    x, y = _batch()
+    out, yb, lam = mixup_cutmix(jax.random.PRNGKey(2), x, y, 0.0, 1.0)
+    o, xs = np.asarray(out), np.asarray(x)
+    lam = float(lam)
+    assert 0.0 <= lam <= 1.0
+    # every output pixel equals the corresponding pixel of x or of the
+    # SAME paired row; the fraction equal to x matches lam
+    same = np.isclose(o, xs).all(-1)              # [B, H, W]
+    frac = same.mean()
+    assert abs(frac - lam) < 0.05  # box-quantization slack
+    # and the box is contiguous: per row, the non-same region is a box
+    b0 = ~same[0]
+    if b0.any():
+        rows = np.where(b0.any(1))[0]
+        cols = np.where(b0.any(0))[0]
+        assert b0[rows[0]:rows[-1] + 1, cols[0]:cols[-1] + 1].all()
+
+
+def test_both_alphas_pick_one_per_step():
+    """With both alphas set, some steps mix and some cut: CutMix output
+    pixels are exact copies of SOME batch row, mixup pixels (lam
+    strictly inside (0,1)) generically match none."""
+    x, y = _batch()
+    kinds = set()
+    xs = np.asarray(x)
+    for seed in range(10):
+        out, _, lam = mixup_cutmix(jax.random.PRNGKey(seed), x, y,
+                                   1.0, 1.0)
+        o = np.asarray(out)
+        # fraction of pixels of image 0 equal to that pixel in any row
+        eq_any = np.isclose(o[0][None], xs).all(-1).any(0).mean()
+        kinds.add("cutmix" if eq_any > 0.99 else "mixup")
+    assert kinds == {"cutmix", "mixup"}, kinds
+
+
+def test_trainer_with_mixup_and_cutmix():
+    cfg = TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic", image_size=32, batch_size=16,
+                        synthetic_train_size=32, synthetic_test_size=16,
+                        mixup_alpha=0.4, cutmix_alpha=1.0),
+        model=ModelConfig(name="vit", vit_patch=4, vit_hidden=64,
+                          vit_depth=2, vit_heads=4, dropout_rate=0.0,
+                          dtype="float32"),
+        optim=OptimConfig(learning_rate=1e-3),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    trainer = Trainer(cfg)
+    try:
+        m = trainer.train_one_epoch(1)
+        assert np.isfinite(m["loss"]) and m["count"] == 32.0
+        e = trainer.evaluate()  # eval path is untouched by mixing
+        assert np.isfinite(e["loss"])
+    finally:
+        trainer.close()
+
+
+def test_cli_flags():
+    from tpunet.config import config_from_args
+    cfg = config_from_args(["--mixup", "0.4", "--cutmix", "1.0"])
+    assert cfg.data.mixup_alpha == 0.4
+    assert cfg.data.cutmix_alpha == 1.0
+
+
+def test_validation():
+    import dataclasses
+    base = TrainConfig(
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        synthetic_train_size=32, synthetic_test_size=16,
+                        seq_len=32, vocab_size=32, mixup_alpha=0.4),
+        model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                          vit_heads=4, dropout_rate=0.0, dtype="float32",
+                          vocab_size=32, max_seq_len=32),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    with pytest.raises(ValueError, match="image-family"):
+        Trainer(base)
+    img = dataclasses.replace(
+        base,
+        data=DataConfig(dataset="synthetic", image_size=32,
+                        batch_size=16, synthetic_train_size=32,
+                        synthetic_test_size=16, mixup_alpha=-0.1),
+        model=ModelConfig(name="vit", vit_patch=4, vit_hidden=64,
+                          vit_depth=2, vit_heads=4, dtype="float32"))
+    with pytest.raises(ValueError, match=">= 0"):
+        Trainer(img)

@@ -50,6 +50,11 @@ class DataConfig:
     rotation_degrees: float = 15.0
     mean: Tuple[float, float, float] = IMAGENET_MEAN
     std: Tuple[float, float, float] = IMAGENET_STD
+    # Mixup / CutMix (beyond the reference's transforms; 0 = off, the
+    # reference behavior). Beta(alpha, alpha) mixing inside the jitted
+    # step; with both > 0 each step picks one at random.
+    mixup_alpha: float = 0.0
+    cutmix_alpha: float = 0.0
     # Synthetic-dataset sizes (CIFAR-10-shaped stand-in for hermetic runs).
     synthetic_train_size: int = 50_000
     synthetic_test_size: int = 10_000
@@ -376,6 +381,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "per-epoch only, like the reference)")
     p.add_argument("--no-native-loader", action="store_true",
                    help="force the pure-numpy host batch path")
+    p.add_argument("--mixup", type=float, default=None, metavar="ALPHA",
+                   help="mixup Beta(a,a) strength for image models; "
+                        "0 = off")
+    p.add_argument("--cutmix", type=float, default=None, metavar="ALPHA",
+                   help="CutMix Beta(a,a) strength; with --mixup, each "
+                        "step picks one at random")
     p.add_argument("--pallas-depthwise", default=None,
                    action=argparse.BooleanOptionalAction,
                    help="route 3x3 depthwise convs through the Pallas "
@@ -400,6 +411,10 @@ def config_from_args(argv=None) -> TrainConfig:
         data = dataclasses.replace(data, native_loader=False)
     if args.text_file is not None:
         data = dataclasses.replace(data, text_path=args.text_file)
+    if args.mixup is not None:
+        data = dataclasses.replace(data, mixup_alpha=args.mixup)
+    if args.cutmix is not None:
+        data = dataclasses.replace(data, cutmix_alpha=args.cutmix)
     if args.seq_len is not None:
         data = dataclasses.replace(data, seq_len=args.seq_len)
     if args.max_seq_len is not None:

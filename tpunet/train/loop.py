@@ -90,6 +90,12 @@ class Trainer:
         if cfg.log_every_steps < 0:
             raise ValueError(f"log_every_steps must be >= 0, got "
                              f"{cfg.log_every_steps}")
+        if cfg.data.mixup_alpha < 0 or cfg.data.cutmix_alpha < 0:
+            raise ValueError("mixup/cutmix alphas must be >= 0")
+        if self.is_lm and (cfg.data.mixup_alpha > 0
+                           or cfg.data.cutmix_alpha > 0):
+            raise ValueError("mixup/cutmix are image-family options; "
+                             "the LM train step does not read them")
         if not 0.0 <= cfg.optim.warmup_epochs < cfg.epochs:
             # warmup >= the whole run would keep every step on the ramp
             # (base LR never reached, cosine horizon collapses to 1).

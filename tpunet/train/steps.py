@@ -20,7 +20,8 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpunet.config import DataConfig, ModelConfig, OptimConfig
-from tpunet.data.augment import make_eval_preprocess, make_train_augment
+from tpunet.data.augment import (make_eval_preprocess, make_train_augment,
+                                 mixup_cutmix)
 from tpunet.train import metrics as M
 from tpunet.train.state import TrainState
 
@@ -141,10 +142,15 @@ def make_train_step(data_cfg: DataConfig,
     augment = make_train_augment(data_cfg)
     smoothing = optim_cfg.label_smoothing
     aux_weight = model_cfg.moe_aux_weight if model_cfg is not None else 0.0
+    mixing = data_cfg.mixup_alpha > 0 or data_cfg.cutmix_alpha > 0
 
     def micro(params, batch_stats, apply_fn, images_u8, labels, rng):
-        aug_rng, dropout_rng = jax.random.split(rng)
+        aug_rng, dropout_rng, mix_rng = jax.random.split(rng, 3)
         images = augment(aug_rng, images_u8)
+        if mixing:
+            images, labels_b, lam = mixup_cutmix(
+                mix_rng, images, labels,
+                data_cfg.mixup_alpha, data_cfg.cutmix_alpha)
 
         def loss_fn(params):
             # mutable=["batch_stats"] is harmless for models without
@@ -155,8 +161,13 @@ def make_train_step(data_cfg: DataConfig,
                 images, train=True,
                 rngs={"dropout": dropout_rng},
                 mutable=["batch_stats", "losses"])
-            loss = _with_aux(_ce_loss(logits, labels, smoothing).mean(),
-                             mutated, aux_weight)
+            ce = _ce_loss(logits, labels, smoothing)
+            if mixing:
+                # Convex label combination; accuracy below stays vs the
+                # PRIMARY label (standard mixup reporting).
+                ce = lam * ce + (1.0 - lam) * _ce_loss(logits, labels_b,
+                                                       smoothing)
+            loss = _with_aux(ce.mean(), mutated, aux_weight)
             return loss, (logits, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
