@@ -144,3 +144,31 @@ def test_cli_flags(tmp_path):
                             "corpus.txt", "--model", "lm"])
     assert cfg.data.dataset == "text_lm"
     assert cfg.data.text_path == "corpus.txt"
+
+
+def test_top_k_and_top_p_sampling(tmp_path):
+    """top_k=1 equals greedy regardless of temperature; top_p strictly
+    inside (0,1) also constrains to high-probability tokens."""
+    import jax
+    from tpunet.models import create_model, init_variables
+    from tpunet.models.lm import generate
+
+    model = create_model(dataclasses.replace(LM_CFG, vocab_size=32))
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    greedy = np.asarray(generate(model, variables, prompt, 8))
+    k1 = np.asarray(generate(model, variables, prompt, 8,
+                             temperature=5.0, top_k=1,
+                             rng=jax.random.PRNGKey(7)))
+    np.testing.assert_array_equal(greedy, k1)
+    # tiny nucleus at low temperature behaves greedily too
+    p_small = np.asarray(generate(model, variables, prompt, 8,
+                                  temperature=0.01, top_p=1e-6,
+                                  rng=jax.random.PRNGKey(7)))
+    np.testing.assert_array_equal(greedy, p_small)
+    # high temperature with a generous nucleus still yields valid tokens
+    free = np.asarray(generate(model, variables, prompt, 8,
+                               temperature=2.0, top_k=8, top_p=0.9,
+                               rng=jax.random.PRNGKey(7)))
+    assert free.shape == greedy.shape
+    assert (free >= 0).all() and (free < 32).all()

@@ -54,13 +54,15 @@ def load_lm(model_cfg: ModelConfig,
 
 
 def generate_text(model, variables, prompt: str, n_new: int,
-                  temperature: float = 0.0, seed: int = 0) -> str:
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 0.0, seed: int = 0) -> str:
     """Byte-level helper: UTF-8 prompt in, UTF-8 continuation out."""
     toks = np.frombuffer(prompt.encode("utf-8"), np.uint8)
     if toks.size == 0:
         raise ValueError("prompt must be non-empty")
     out = generate(model, variables, toks[None].astype(np.int32), n_new,
-                   temperature=temperature, rng=jax.random.PRNGKey(seed))
+                   temperature=temperature, top_k=top_k, top_p=top_p,
+                   rng=jax.random.PRNGKey(seed))
     new = np.asarray(out)[0, toks.size:]
     return bytes(np.clip(new, 0, 255).astype(np.uint8)).decode(
         "utf-8", errors="replace")
@@ -76,6 +78,12 @@ def main(argv=None):
                    help="number of new tokens to generate")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy; >0 samples softmax(logits/T)")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="truncate sampling to the k most-likely tokens "
+                        "(0 = off)")
+    p.add_argument("--top-p", type=float, default=0.0,
+                   help="nucleus sampling: smallest cumulative-"
+                        "probability mass to sample from (0 = off)")
     p.add_argument("--seed", type=int, default=0)
     # Architecture of the trained checkpoint (must match training).
     p.add_argument("--vit-hidden", type=int, default=192)
@@ -84,6 +92,11 @@ def main(argv=None):
     p.add_argument("--vocab-size", type=int, default=256)
     p.add_argument("--max-seq-len", type=int, default=1024)
     args = p.parse_args(argv)
+
+    if (args.top_k or args.top_p) and args.temperature <= 0:
+        raise SystemExit("--top-k/--top-p filter SAMPLING; set "
+                         "--temperature > 0 (temperature 0 is greedy "
+                         "decoding and would silently ignore them)")
 
     cfg = ModelConfig(name="lm", vit_hidden=args.vit_hidden,
                       vit_depth=args.vit_depth, vit_heads=args.vit_heads,
@@ -116,12 +129,15 @@ def main(argv=None):
     model, variables = load_lm(cfg, checkpoint_dir=args.checkpoint_dir)
     if args.vocab_size == 256:
         text = generate_text(model, variables, args.prompt, args.tokens,
-                             temperature=args.temperature, seed=args.seed)
+                             temperature=args.temperature,
+                             top_k=args.top_k, top_p=args.top_p,
+                             seed=args.seed)
         print(args.prompt + text)
     else:
         toks = np.asarray(prompt_toks, np.int32)[None]
         out = generate(model, variables, toks, args.tokens,
-                       temperature=args.temperature,
+                       temperature=args.temperature, top_k=args.top_k,
+                       top_p=args.top_p,
                        rng=jax.random.PRNGKey(args.seed))
         print(" ".join(str(t) for t in np.asarray(out)[0]))
 

@@ -112,10 +112,14 @@ def create_model(cfg: ModelConfig, mesh=None) -> TransformerLM:
 
 
 def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
-             *, temperature: float = 0.0, rng=None,
+             *, temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0, rng=None,
              use_cache: bool = True):
     """Greedy (or sampled) autoregressive generation from ``prompt``
-    [B, T0] int32.
+    [B, T0] int32. ``temperature`` 0 = greedy; > 0 samples
+    softmax(logits/T), optionally truncated to the ``top_k``
+    highest-probability tokens and/or the smallest ``top_p``
+    cumulative-probability nucleus (both 0 = off).
 
     Default path: incremental decoding against the KV cache — O(L) work
     per token, one jitted single-token program compiled once, prompt
@@ -136,9 +140,27 @@ def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
                             max(1, n_new))
 
     def pick(lg, key):
-        if temperature > 0:
-            return jax.random.categorical(key, lg / temperature, -1)
-        return jnp.argmax(lg, -1)
+        if temperature <= 0:
+            return jnp.argmax(lg, -1)
+        lg = lg / temperature
+        need_sort = (top_k > 0 and top_k < lg.shape[-1]) \
+            or 0.0 < top_p < 1.0
+        if need_sort:
+            srt = jnp.sort(lg, -1)[..., ::-1]  # one descending sort
+        if top_k > 0 and top_k < lg.shape[-1]:
+            lg = jnp.where(lg >= srt[..., top_k - 1:top_k], lg, -jnp.inf)
+        if 0.0 < top_p < 1.0:
+            # Nucleus: keep the smallest prefix of the sorted
+            # distribution whose mass reaches top_p (the top token
+            # always survives). Works on the pre-top_k sort: the
+            # nucleus cutoff only moves UP if top_k already removed
+            # tail mass, and lg keeps both filters via the two wheres.
+            probs = jax.nn.softmax(srt, -1)
+            keep = jnp.cumsum(probs, -1) - probs < top_p
+            cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), -1,
+                             keepdims=True)
+            lg = jnp.where(lg >= cutoff, lg, -jnp.inf)
+        return jax.random.categorical(key, lg, -1)
 
     if use_cache:
         total = t0 + n_new

@@ -145,7 +145,13 @@ def make_train_step(data_cfg: DataConfig,
     mixing = data_cfg.mixup_alpha > 0 or data_cfg.cutmix_alpha > 0
 
     def micro(params, batch_stats, apply_fn, images_u8, labels, rng):
-        aug_rng, dropout_rng, mix_rng = jax.random.split(rng, 3)
+        if mixing:
+            aug_rng, dropout_rng, mix_rng = jax.random.split(rng, 3)
+        else:
+            # 2-way split when not mixing: keeps the augment/dropout
+            # streams (and thus seed-for-seed runs) identical to
+            # configs that predate the mixup option.
+            aug_rng, dropout_rng = jax.random.split(rng)
         images = augment(aug_rng, images_u8)
         if mixing:
             images, labels_b, lam = mixup_cutmix(
