@@ -20,8 +20,33 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def fsdp_lm_case():
+    """(cfg, dataset) for the FSDP+grad-accum LM case — the ONE source
+    of truth shared by the worker and the test's single-process
+    reference (FSDP: params + Adam moments sharded over the
+    cross-process 'data' axis)."""
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.data.lm import synthetic_lm
+
+    cfg = TrainConfig(
+        epochs=1, seed=42,
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        seq_len=32, vocab_size=32),
+        model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                          vit_heads=4, dropout_rate=0.0,
+                          dtype="float32", vocab_size=32,
+                          max_seq_len=32),
+        optim=OptimConfig(learning_rate=3e-3, grad_accum=2),
+        mesh=MeshConfig(fsdp=True),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    return cfg, synthetic_lm(64, 32, seq_len=32, vocab=32, seed=7)
+
+
 def main():
     coordinator, num_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_procs,
@@ -36,19 +61,22 @@ def main():
     from tpunet.parallel import sync_hosts
     from tpunet.train.loop import Trainer
 
-    cfg = TrainConfig(
-        epochs=1, seed=42,
-        data=DataConfig(dataset="synthetic", image_size=32, batch_size=16,
-                        rrc_scale=(1.0, 1.0), rrc_ratio=(1.0, 1.0),
-                        jitter_brightness=0.0, jitter_contrast=0.0,
-                        jitter_saturation=0.0, jitter_hue=0.0,
-                        rotation_degrees=0.0),
-        model=ModelConfig(dtype="float32", width_mult=0.5),
-        optim=OptimConfig(learning_rate=1e-3),
-        mesh=MeshConfig(),  # all 8 global devices on the data axis
-        checkpoint=CheckpointConfig(save_best=False, save_last=False),
-    )
-    ds = synthetic_cifar10(n_train=64, n_test=32, seed=7)
+    if mode == "fsdp_lm":
+        cfg, ds = fsdp_lm_case()
+    else:
+        cfg = TrainConfig(
+            epochs=1, seed=42,
+            data=DataConfig(dataset="synthetic", image_size=32, batch_size=16,
+                            rrc_scale=(1.0, 1.0), rrc_ratio=(1.0, 1.0),
+                            jitter_brightness=0.0, jitter_contrast=0.0,
+                            jitter_saturation=0.0, jitter_hue=0.0,
+                            rotation_degrees=0.0),
+            model=ModelConfig(dtype="float32", width_mult=0.5),
+            optim=OptimConfig(learning_rate=1e-3),
+            mesh=MeshConfig(),  # all 8 global devices on the data axis
+            checkpoint=CheckpointConfig(save_best=False, save_last=False),
+        )
+        ds = synthetic_cifar10(n_train=64, n_test=32, seed=7)
     trainer = Trainer(cfg, dataset=ds)
     sync_hosts("start")
     eval0 = trainer.evaluate()

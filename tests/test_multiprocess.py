@@ -28,28 +28,41 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training_parity():
+
+def _run_workers(mode=None, timeout=600):
+    """Launch the two worker controllers and return their parsed JSON
+    outputs; workers are killed on ANY failure (a rendezvous deadlock
+    must not outlive the test)."""
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS")}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    argv_tail = [mode] if mode else []
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(HERE, "_mp_worker.py"),
-             coordinator, "2", str(pid)],
+             coordinator, "2", str(pid)] + argv_tail,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env, cwd=REPO)
         for pid in range(2)
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=600)
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
 
-    a, b = outs
+
+@pytest.mark.slow
+def test_two_process_training_parity():
+    a, b = _run_workers()
     assert a["world"] == b["world"] == 2
     assert a["devices"] == b["devices"] == 8
     # Global metrics identical on both controllers (same psum results).
@@ -68,8 +81,40 @@ def test_two_process_training_parity():
 
     cfg = tiny_config(os.path.join(REPO, "/tmp"), batch=16, epochs=1)
     t = Trainer(cfg, dataset=synthetic_cifar10(n_train=64, n_test=32, seed=7))
-    e = t.evaluate()
-    assert e["count"] == a["eval0"]["count"]
-    assert np.isclose(e["loss"], a["eval0"]["loss"], rtol=1e-4)
-    m = t.train_one_epoch(0)
-    assert np.isclose(m["loss"], a["train1"]["loss"], rtol=2e-2)
+    try:
+        e = t.evaluate()
+        assert e["count"] == a["eval0"]["count"]
+        assert np.isclose(e["loss"], a["eval0"]["loss"], rtol=1e-4)
+        m = t.train_one_epoch(0)
+        assert np.isclose(m["loss"], a["train1"]["loss"], rtol=2e-2)
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
+def test_two_process_fsdp_grad_accum_lm():
+    """FSDP (params + moments sharded over the CROSS-PROCESS data axis)
+    + grad accumulation on the LM family: both controllers must agree
+    on the global metrics, and match a single-process run of the same
+    global mesh to 1e-4 relative in eval (train to Adam tolerance).
+    The config comes from _mp_worker.fsdp_lm_case — ONE source of truth
+    for the worker and the reference."""
+    a, b = _run_workers(mode="fsdp_lm")
+    assert a["devices"] == b["devices"] == 8
+    for section in ("eval0", "train1"):
+        assert np.isclose(a[section]["loss"], b[section]["loss"], rtol=1e-6)
+        assert a[section]["count"] == b[section]["count"]
+
+    # single-process reference on the same 8-device global mesh
+    from tpunet.train.loop import Trainer
+    from _mp_worker import fsdp_lm_case
+    cfg, ds = fsdp_lm_case()
+    t = Trainer(cfg, dataset=ds)
+    try:
+        e = t.evaluate()
+        assert e["count"] == a["eval0"]["count"]
+        assert np.isclose(e["loss"], a["eval0"]["loss"], rtol=1e-4)
+        m = t.train_one_epoch(0)
+        assert np.isclose(m["loss"], a["train1"]["loss"], rtol=2e-2)
+    finally:
+        t.close()
