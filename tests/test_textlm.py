@@ -172,3 +172,49 @@ def test_top_k_and_top_p_sampling(tmp_path):
                                rng=jax.random.PRNGKey(7)))
     assert free.shape == greedy.shape
     assert (free >= 0).all() and (free < 32).all()
+
+
+def test_filter_logits_sequential_semantics():
+    """Combined top-k + top-p is sequential (HF warpers): the nucleus is
+    computed over the RENORMALIZED post-top-k distribution. With probs
+    [0.2, 0.1, tail...] and top_k=2, top_p=0.5 the renormalized top
+    token carries 0.667 >= 0.5, so ONLY it survives — the old
+    intersection semantics (nucleus over the raw distribution) would
+    also have kept the second token (raw cumsum 0.2 < 0.5)."""
+    import jax.numpy as jnp
+    from tpunet.models.lm import filter_logits
+
+    probs = np.full(16, 0.05)
+    probs[0], probs[1] = 0.2, 0.1
+    probs /= probs.sum()
+    lg = jnp.log(jnp.asarray(probs))
+    out = np.asarray(filter_logits(lg, top_k=2, top_p=0.5))
+    assert np.isfinite(out[0])
+    assert not np.isfinite(out[1:]).any()
+    # Each filter alone is unchanged by the refactor.
+    k_only = np.asarray(filter_logits(lg, top_k=2))
+    assert np.isfinite(k_only[:2]).all() and not np.isfinite(k_only[2:]).any()
+    p_only = np.asarray(filter_logits(lg, top_p=0.25))
+    assert np.isfinite(p_only[0]) and np.isfinite(p_only[1])
+
+
+def test_prompt_format_flag(tmp_path, capsys):
+    """--prompt-format overrides the vocab-size-256 heuristic in both
+    directions; 'bytes' with a small vocab is rejected up front."""
+    from tpunet.infer import generate as gen
+    argv = ["--checkpoint-dir", str(tmp_path / "nope"), "--tokens", "4",
+            "--vocab-size", "16", "--max-seq-len", "32"]
+    with pytest.raises(SystemExit, match="vocab-size 256"):
+        gen.main(argv + ["--prompt-format", "bytes", "--prompt", "hi"])
+    with pytest.raises(SystemExit, match="vocab-size 256"):
+        gen.main(["--checkpoint-dir", str(tmp_path / "nope"), "--tokens",
+                  "4", "--vocab-size", "512", "--max-seq-len", "32",
+                  "--prompt-format", "bytes", "--prompt", "hi"])
+    # vocab 256 + explicit ids: parsed as token ids, not UTF-8 text.
+    argv256 = ["--checkpoint-dir", str(tmp_path / "nope"), "--tokens", "4",
+               "--vocab-size", "256", "--max-seq-len", "32",
+               "--prompt-format", "ids"]
+    with pytest.raises(SystemExit, match="token ids"):
+        gen.main(argv256 + ["--prompt", "not numbers"])
+    with pytest.raises(SystemExit, match="outside"):
+        gen.main(argv256 + ["--prompt", "5 300"])

@@ -91,7 +91,21 @@ def main(argv=None):
     p.add_argument("--vit-heads", type=int, default=3)
     p.add_argument("--vocab-size", type=int, default=256)
     p.add_argument("--max-seq-len", type=int, default=1024)
+    p.add_argument("--prompt-format", choices=("auto", "bytes", "ids"),
+                   default="auto",
+                   help="how to read --prompt: 'bytes' = UTF-8 text "
+                        "(byte-level --dataset text_lm checkpoints), "
+                        "'ids' = space-separated token ids; 'auto' "
+                        "picks bytes iff --vocab-size is 256")
     args = p.parse_args(argv)
+    byte_prompt = (args.vocab_size == 256
+                   if args.prompt_format == "auto"
+                   else args.prompt_format == "bytes")
+    if byte_prompt and args.vocab_size != 256:
+        # generate_text round-trips tokens as raw bytes; any other vocab
+        # would silently clip sampled ids into [0, 255].
+        raise SystemExit(f"--prompt-format bytes needs vocab-size 256 "
+                         f"(got {args.vocab_size})")
 
     if (args.top_k or args.top_p) and args.temperature <= 0:
         raise SystemExit("--top-k/--top-p filter SAMPLING; set "
@@ -102,20 +116,20 @@ def main(argv=None):
                       vit_depth=args.vit_depth, vit_heads=args.vit_heads,
                       vocab_size=args.vocab_size,
                       max_seq_len=args.max_seq_len, dropout_rate=0.0)
-    if args.vocab_size == 256:
+    if byte_prompt:
         # Byte-level checkpoint (--dataset text_lm): the prompt IS text.
         prompt_len = len(args.prompt.encode("utf-8"))
         if prompt_len == 0:
             raise SystemExit("--prompt must be non-empty")
     else:
-        # Other vocabs: the prompt is space-separated token ids.
+        # The prompt is space-separated token ids.
         try:
             prompt_toks = [int(t) for t in args.prompt.split()]
         except ValueError:
             raise SystemExit(
-                f"--vocab-size {args.vocab_size} checkpoints take the "
-                f"prompt as space-separated token ids, e.g. "
-                f"--prompt '5 7 3'; got {args.prompt!r}")
+                f"--prompt-format ids takes the prompt as space-"
+                f"separated token ids, e.g. --prompt '5 7 3'; got "
+                f"{args.prompt!r} (use --prompt-format bytes for text)")
         if not prompt_toks:
             raise SystemExit("--prompt must contain at least one token id")
         bad = [t for t in prompt_toks if not 0 <= t < args.vocab_size]
@@ -127,7 +141,7 @@ def main(argv=None):
         raise SystemExit(f"prompt+tokens = {prompt_len + args.tokens} "
                          f"exceeds --max-seq-len {cfg.max_seq_len}")
     model, variables = load_lm(cfg, checkpoint_dir=args.checkpoint_dir)
-    if args.vocab_size == 256:
+    if byte_prompt:
         text = generate_text(model, variables, args.prompt, args.tokens,
                              temperature=args.temperature,
                              top_k=args.top_k, top_p=args.top_p,

@@ -68,3 +68,9 @@ def main(argv=None) -> int:
     return 0
 
 
+if __name__ == "__main__":  # python -m tpunet.main
+    import sys
+
+    sys.exit(main())
+
+

@@ -111,6 +111,28 @@ def create_model(cfg: ModelConfig, mesh=None) -> TransformerLM:
     )
 
 
+def filter_logits(lg, *, top_k: int = 0, top_p: float = 0.0):
+    """Truncate ``lg`` [..., V] for sampling: tokens outside the filters
+    become -inf. Sequential HF-warper semantics: top-k first, then the
+    nucleus over the RENORMALIZED post-top-k distribution (computing the
+    nucleus on the raw distribution would admit a larger, more
+    permissive nucleus whenever top-k removed tail mass)."""
+    need_sort = (top_k > 0 and top_k < lg.shape[-1]) or 0.0 < top_p < 1.0
+    if need_sort:
+        srt = jnp.sort(lg, -1)[..., ::-1]  # one descending sort
+    if top_k > 0 and top_k < lg.shape[-1]:
+        lg = jnp.where(lg >= srt[..., top_k - 1:top_k], lg, -jnp.inf)
+        srt = jnp.where(jnp.arange(srt.shape[-1]) < top_k, srt, -jnp.inf)
+    if 0.0 < top_p < 1.0:
+        # Keep the smallest prefix of the sorted distribution whose
+        # mass reaches top_p (the top token always survives).
+        probs = jax.nn.softmax(srt, -1)
+        keep = jnp.cumsum(probs, -1) - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), -1, keepdims=True)
+        lg = jnp.where(lg >= cutoff, lg, -jnp.inf)
+    return lg
+
+
 def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
              *, temperature: float = 0.0, top_k: int = 0,
              top_p: float = 0.0, rng=None,
@@ -142,24 +164,7 @@ def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
     def pick(lg, key):
         if temperature <= 0:
             return jnp.argmax(lg, -1)
-        lg = lg / temperature
-        need_sort = (top_k > 0 and top_k < lg.shape[-1]) \
-            or 0.0 < top_p < 1.0
-        if need_sort:
-            srt = jnp.sort(lg, -1)[..., ::-1]  # one descending sort
-        if top_k > 0 and top_k < lg.shape[-1]:
-            lg = jnp.where(lg >= srt[..., top_k - 1:top_k], lg, -jnp.inf)
-        if 0.0 < top_p < 1.0:
-            # Nucleus: keep the smallest prefix of the sorted
-            # distribution whose mass reaches top_p (the top token
-            # always survives). Works on the pre-top_k sort: the
-            # nucleus cutoff only moves UP if top_k already removed
-            # tail mass, and lg keeps both filters via the two wheres.
-            probs = jax.nn.softmax(srt, -1)
-            keep = jnp.cumsum(probs, -1) - probs < top_p
-            cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), -1,
-                             keepdims=True)
-            lg = jnp.where(lg >= cutoff, lg, -jnp.inf)
+        lg = filter_logits(lg / temperature, top_k=top_k, top_p=top_p)
         return jax.random.categorical(key, lg, -1)
 
     if use_cache:
