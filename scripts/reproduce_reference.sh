@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Reproduce the reference's headline deliverable: ImageNet-pretrained
+# MobileNetV2 fine-tuned on real CIFAR-10 @ 224px, 20 epochs, batch 128
+# (reference cifar10_128batch.py; published record
+# cifar10_128_gpu_27326.out:30-52 — epoch-1 acc 0.9027, best 0.9603,
+# total 10,698 s on one V100).
+#
+# Turnkey when the machine has egress (CIFAR-10 tarball and torchvision
+# weights are fetched, checksum-verified, into data/ and
+# ~/.cache/tpunet). Offline: stage the two artifacts per the printed
+# drop-in instructions, then rerun.
+#
+#   bash scripts/reproduce_reference.sh [extra train.py flags...]
+#
+# Artifacts land in runs/real-single/: epoch log (train.log),
+# metrics.jsonl, best + last checkpoints. Expected on one TPU chip:
+# epoch-1 test acc ~0.89-0.91, best >= 0.95, wall-clock far under the
+# V100's 10,698 s (bench.py measures ~39x the V100's throughput).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=runs/real-single
+mkdir -p "$OUT"
+
+# metrics.jsonl is written into the checkpoint dir by the trainer.
+python -u train.py --preset single \
+  --dataset cifar10 \
+  --pretrained auto \
+  --checkpoint-dir "$OUT/ckpt" \
+  "$@" 2>&1 | tee "$OUT/train.log"

@@ -38,8 +38,9 @@ def test_load_cifar10_pickle_layout(tmp_path):
 
 
 def test_load_cifar10_missing_raises(tmp_path):
-    with pytest.raises(FileNotFoundError, match="synthetic"):
-        load_cifar10(str(tmp_path / "nope"))
+    from tpunet.data.download import DownloadError
+    with pytest.raises(DownloadError, match="synthetic"):
+        load_cifar10(str(tmp_path / "nope"), download=False)
 
 
 def test_synthetic_separable():

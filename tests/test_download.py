@@ -1,0 +1,116 @@
+"""Dataset/weight acquisition (tpunet/data/download.py).
+
+The reference's download path is torchvision ``download=True`` plus a
+rank-0 barrier (cifar10_mpi_mobilenet_224.py:93-102); these tests drive
+tpunet's checksum-verified equivalent against a loopback HTTP server
+(hermetic — no egress required).
+"""
+
+import hashlib
+import http.server
+import os
+import threading
+
+import pytest
+
+from tpunet.data.download import (CIFAR10_MD5, DownloadError, ensure_cifar10,
+                                  ensure_mobilenet_v2_weights, fetch)
+
+PAYLOAD = b"tpunet-test-payload" * 100
+
+
+@pytest.fixture()
+def http_url():
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = PAYLOAD if self.path == "/file.bin" else b""
+            self.send_response(200 if body else 404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_fetch_verifies_checksums(http_url, tmp_path):
+    dest = str(tmp_path / "out.bin")
+    md5 = hashlib.md5(PAYLOAD).hexdigest()
+    sha8 = hashlib.sha256(PAYLOAD).hexdigest()[:8]
+    assert fetch(f"{http_url}/file.bin", dest, md5=md5,
+                 sha256_prefix=sha8) == dest
+    assert open(dest, "rb").read() == PAYLOAD
+
+
+def test_fetch_rejects_corruption_and_cleans_up(http_url, tmp_path):
+    dest = str(tmp_path / "out.bin")
+    with pytest.raises(DownloadError, match="md5"):
+        fetch(f"{http_url}/file.bin", dest, md5="0" * 32)
+    # neither the dest nor any .part temp file survives a failed fetch
+    assert os.listdir(tmp_path) == []
+    with pytest.raises(DownloadError, match="sha256"):
+        fetch(f"{http_url}/file.bin", dest, sha256_prefix="ffffffff")
+    assert os.listdir(tmp_path) == []
+
+
+def test_fetch_network_failure(tmp_path):
+    with pytest.raises(DownloadError, match="failed"):
+        fetch("http://127.0.0.1:9/nope", str(tmp_path / "x"), timeout=0.5)
+    assert os.listdir(tmp_path) == []
+
+
+def test_ensure_cifar10_disabled_documents_drop_in(tmp_path):
+    with pytest.raises(DownloadError) as e:
+        ensure_cifar10(str(tmp_path), download=False)
+    msg = str(e.value)
+    assert "cifar-10-python.tar.gz" in msg
+    assert CIFAR10_MD5 in msg           # drop-in checksum is actionable
+    assert str(tmp_path) in msg
+
+
+def test_ensure_cifar10_present_skips_download(tmp_path):
+    # an extracted dir short-circuits entirely; a staged tarball is
+    # md5-verified (drop-in integrity) but touches no network
+    (tmp_path / "d" / "cifar-10-batches-py").mkdir(parents=True)
+    assert ensure_cifar10(str(tmp_path / "d"), download=True)
+    (tmp_path / "cifar-10-python.tar.gz").write_bytes(b"truncated junk")
+    with pytest.raises(DownloadError, match="corrupt"):
+        ensure_cifar10(str(tmp_path), download=True)
+
+
+def test_ensure_weights_present_and_disabled(tmp_path):
+    p = tmp_path / "mobilenet_v2-b0353104.pth"
+    p.write_bytes(b"weights")
+    assert ensure_mobilenet_v2_weights(str(p)) == str(p)
+    with pytest.raises(DownloadError, match="b0353104"):
+        ensure_mobilenet_v2_weights(str(tmp_path / "absent.pth"),
+                                    download=False)
+
+
+def test_no_download_flag_plumbs_through():
+    from tpunet.config import config_from_args
+    assert config_from_args([]).data.download is True
+    assert config_from_args(["--no-download"]).data.download is False
+
+
+def test_pretrained_auto_resolves_in_trainer(tmp_path, monkeypatch):
+    """--pretrained auto resolves through ensure_mobilenet_v2_weights
+    inside the Trainer (process-0-gated); with downloads disabled and no
+    cached file it fails actionably instead of training silently
+    from-scratch."""
+    from tpunet.config import config_from_args
+    from tpunet.train.loop import Trainer
+
+    monkeypatch.setenv("HOME", str(tmp_path))  # empty ~/.cache/tpunet
+    cfg = config_from_args(
+        ["--dataset", "synthetic", "--synthetic-size", "64",
+         "--batch-size", "32", "--image-size", "32", "--epochs", "1",
+         "--pretrained", "auto", "--no-download"])
+    with pytest.raises(DownloadError, match="drop-in|Drop-in"):
+        Trainer(cfg)

@@ -35,6 +35,9 @@ class DataConfig:
 
     data_dir: str = "data"
     dataset: str = "cifar10"          # "cifar10" | "synthetic"
+    # Auto-download CIFAR-10 when absent (reference download=True, :97).
+    # --no-download disables; the error then documents the drop-in path.
+    download: bool = True
     image_size: int = 224             # reference IMG_SIZE (:70)
     batch_size: int = 128             # GLOBAL batch (reference :117 is per-rank)
     eval_batch_size: int = 0          # 0 -> same as batch_size
@@ -304,8 +307,15 @@ def build_argparser() -> argparse.ArgumentParser:
                             "text_lm"])
     p.add_argument("--text-file", default=None,
                    help="byte-level corpus file for --dataset text_lm")
-    p.add_argument("--pretrained", default=None,
-                   help="path to a torch MobileNetV2 state_dict to convert")
+    p.add_argument("--no-download", action="store_true",
+                   help="never fetch CIFAR-10/pretrained weights over "
+                        "the network; fail with drop-in instructions "
+                        "instead (reference auto-downloads, :97)")
+    p.add_argument("--pretrained", default=None, metavar="PATH|auto",
+                   help="torch MobileNetV2 state_dict to convert; 'auto' "
+                        "fetches torchvision's ImageNet checkpoint into "
+                        "~/.cache/tpunet (the reference's "
+                        "pretrained=True, :137)")
     p.add_argument("--model", default=None,
                    choices=["mobilenet_v2", "vit", "vit_tiny", "vit_small",
                             "vit_base", "vit_pp", "lm"])
@@ -409,6 +419,8 @@ def config_from_args(argv=None) -> TrainConfig:
         data = dataclasses.replace(data, dataset=args.dataset)
     if args.no_native_loader:
         data = dataclasses.replace(data, native_loader=False)
+    if args.no_download:
+        data = dataclasses.replace(data, download=False)
     if args.text_file is not None:
         data = dataclasses.replace(data, text_path=args.text_file)
     if args.mixup is not None:

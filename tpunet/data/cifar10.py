@@ -19,11 +19,10 @@ from typing import Tuple
 import numpy as np
 
 from tpunet.config import DataConfig
+from tpunet.data.download import BATCH_DIR as _BATCH_DIR
+from tpunet.data.download import TARBALL as _TARBALL
 
 Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
-
-_BATCH_DIR = "cifar-10-batches-py"
-_TARBALL = "cifar-10-python.tar.gz"
 
 
 def _read_batch(path: str) -> Tuple[np.ndarray, np.ndarray]:
@@ -34,12 +33,17 @@ def _read_batch(path: str) -> Tuple[np.ndarray, np.ndarray]:
     return np.ascontiguousarray(data), labels
 
 
-def load_cifar10(data_dir: str) -> Arrays:
-    """Load CIFAR-10 from ``data_dir`` (extracting the tarball if needed).
+def load_cifar10(data_dir: str, download: bool = True) -> Arrays:
+    """Load CIFAR-10 from ``data_dir``, downloading (checksum-verified)
+    and/or extracting the tarball when needed — the reference's
+    ``download=True`` dataset path (cifar10_mpi_mobilenet_224.py:93-102).
 
     Returns (train_x[50000,32,32,3] u8, train_y, test_x[10000,...], test_y).
     """
-    data_dir = os.path.expanduser(data_dir)
+    from tpunet.data.download import ensure_cifar10
+
+    data_dir = ensure_cifar10(os.path.expanduser(data_dir),
+                              download=download)
     batch_dir = os.path.join(data_dir, _BATCH_DIR)
     tarball = os.path.join(data_dir, _TARBALL)
     if not os.path.isdir(batch_dir) and os.path.exists(tarball):
@@ -48,9 +52,7 @@ def load_cifar10(data_dir: str) -> Arrays:
     if not os.path.isdir(batch_dir):
         raise FileNotFoundError(
             f"CIFAR-10 not found under {data_dir!r} (expected "
-            f"{_BATCH_DIR}/ or {_TARBALL}). Place the standard "
-            "cifar-10-python.tar.gz there, or run with "
-            "--dataset synthetic.")
+            f"{_BATCH_DIR}/ or {_TARBALL}).")
     xs, ys = [], []
     for i in range(1, 6):
         x, y = _read_batch(os.path.join(batch_dir, f"data_batch_{i}"))
@@ -90,7 +92,7 @@ def get_dataset(cfg: DataConfig) -> Arrays:
         return synthetic_cifar10(n_train=cfg.synthetic_train_size,
                                  n_test=cfg.synthetic_test_size)
     if cfg.dataset == "cifar10":
-        return load_cifar10(cfg.data_dir)
+        return load_cifar10(cfg.data_dir, download=cfg.download)
     if cfg.dataset in ("synthetic_lm", "text_lm"):
         from tpunet.data.lm import get_lm_dataset
         return get_lm_dataset(cfg)

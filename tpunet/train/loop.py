@@ -62,7 +62,7 @@ class Trainer:
             cfg.model, cfg.optim, root_key(cfg.seed),
             image_size=cfg.data.image_size,
             steps_per_epoch=self.spe, epochs=cfg.epochs, mesh=self.mesh,
-            seq_len=cfg.data.seq_len)
+            seq_len=cfg.data.seq_len, allow_download=cfg.data.download)
         repl = replicated_sharding(self.mesh)
         bsh = batch_sharding(self.mesh)
         # Tensor parallelism: params (and, via mirrored tree paths, their

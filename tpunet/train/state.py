@@ -95,7 +95,8 @@ def make_optimizer(cfg: OptimConfig, steps_per_epoch: int,
 def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
                        rng: jax.Array, *, image_size: int,
                        steps_per_epoch: int, epochs: int,
-                       mesh=None, seq_len: int = 16) -> TrainState:
+                       mesh=None, seq_len: int = 16,
+                       allow_download: bool = True) -> TrainState:
     """Build model variables (optionally overlaying converted pretrained
     torch weights, reference :137-139) and the optimizer state.
 
@@ -121,7 +122,15 @@ def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
             raise ValueError(
                 "pretrained_path converts torchvision MobileNetV2 "
                 f"state_dicts only; model is {model_cfg.name!r}")
-        variables = load_pretrained(model_cfg.pretrained_path, variables,
+        path = model_cfg.pretrained_path
+        if path == "auto":
+            # Resolve/download AFTER the model check above (no wasted
+            # fetch for non-MobileNet models). Under tpunet/main.py's
+            # process-0 gate this is the reference's rank-0 + barrier
+            # download dance (:93-102).
+            from tpunet.data.download import ensure_mobilenet_v2_weights
+            path = ensure_mobilenet_v2_weights(download=allow_download)
+        variables = load_pretrained(path, variables,
                                     num_classes=model_cfg.num_classes)
     tx = make_optimizer(optim_cfg, steps_per_epoch, epochs)
     params = variables["params"]
