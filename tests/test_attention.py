@@ -101,6 +101,7 @@ def test_ring_under_jit_with_sharded_inputs():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_gradients_match_dense(causal):
     mesh = _seq_mesh()
     q, k, v = _qkv(4)
@@ -130,6 +131,7 @@ def test_ulysses_matches_dense(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ulysses_gradients_match_dense(causal):
     mesh = _seq_mesh()
     q, k, v = _qkv(9)
@@ -205,6 +207,7 @@ def test_ring_unknown_core_raises():
         ring_self_attention(q, k, v, mesh, core="blokwise")
 
 
+@pytest.mark.slow
 def test_ring_flash_core_gradients():
     mesh = _seq_mesh()
     q, k, v = _qkv(14)
@@ -237,6 +240,7 @@ def test_ulysses_flash_core_matches_dense(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_flash_core_gradients():
     mesh = _seq_mesh()
     q, k, v = _qkv(11)
@@ -424,6 +428,7 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_spmd_partitions_over_batch_and_heads(self):
         """The custom_partitioning rule: under a (data, model) mesh with
         batch- and head-sharded inputs the kernel runs per-shard (each
@@ -464,6 +469,7 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_lm_trains_with_flash_config(self):
         """attention='flash' wires through the model registry (dense
         fallback on the CPU backend) and trains end-to-end."""

@@ -6,6 +6,7 @@ import dataclasses
 
 import jax
 import numpy as np
+import pytest
 
 from tpunet.config import CheckpointConfig
 from tpunet.train.loop import Trainer
@@ -19,6 +20,7 @@ def _cfg(tmp_path, epochs):
         directory=str(tmp_path / "ckpt"), save_best=True, save_last=True))
 
 
+@pytest.mark.slow
 def test_best_and_state_saved(tmp_path, tiny_dataset):  # noqa: F811
     cfg = _cfg(tmp_path, epochs=2)
     t = Trainer(cfg, dataset=tiny_dataset)
@@ -32,6 +34,7 @@ def test_best_and_state_saved(tmp_path, tiny_dataset):  # noqa: F811
     assert chex_shape == jax.tree_util.tree_structure(t.state.params)
 
 
+@pytest.mark.slow
 def test_resume_continues_from_epoch(tmp_path, tiny_dataset):  # noqa: F811
     cfg = _cfg(tmp_path, epochs=2)
     t = Trainer(cfg, dataset=tiny_dataset)

@@ -42,6 +42,7 @@ def _flax_from_torch(torch_model, num_classes=10):
     return model, merge_pretrained(variables, p, s, head_ok), head_ok
 
 
+@pytest.mark.slow
 def test_logit_parity(torch_model):
     model, variables, head_ok = _flax_from_torch(torch_model)
     assert head_ok

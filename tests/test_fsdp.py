@@ -174,6 +174,7 @@ def test_fsdp_composes_with_tp():
         trainer.close()
 
 
+@pytest.mark.slow
 def test_fsdp_mobilenet_smoke():
     """Conv kernels are HWIO: FSDP shards a channel dim, not dim 0."""
     cfg = TrainConfig(

@@ -51,6 +51,7 @@ def test_pil_and_float_inputs(predictor):
     np.testing.assert_allclose(p1, p3, atol=0.02)  # uint8 quantization
 
 
+@pytest.mark.slow
 def test_predictor_loads_best_checkpoint(tmp_path, tiny_dataset):  # noqa: F811
     cfg = tiny_config(tmp_path, epochs=1).replace(
         checkpoint=CheckpointConfig(directory=str(tmp_path / "ck")))
@@ -68,6 +69,38 @@ def test_missing_checkpoint_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         Predictor(model_cfg=SMALL_MODEL, data_cfg=SMALL_DATA,
                   checkpoint_dir=str(tmp_path / "nope"))
+
+
+@pytest.mark.slow
+def test_web_app_classify_end_to_end(tmp_path, tiny_dataset):  # noqa: F811
+    """Drive the EXACT function the web UI serves (app.make_classify,
+    what gr.Interface(fn=...) wraps) against a trained checkpoint: PIL
+    image in -> {class: prob} dict out, the gr.Label top-3 input format
+    (reference app, GROUP03.pdf pp.22-23)."""
+    from PIL import Image
+
+    from tpunet.infer import app
+
+    cfg = tiny_config(tmp_path, epochs=1).replace(
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "ck")))
+    t = Trainer(cfg, dataset=tiny_dataset)
+    t.train()
+    t.ckpt.close()
+    pred = Predictor(model_cfg=cfg.model, data_cfg=cfg.data,
+                     checkpoint_dir=str(tmp_path / "ck"))
+    classify = app.make_classify(pred)
+
+    img = Image.fromarray(np.asarray(tiny_dataset[0][0]))
+    out = classify(img)
+    # gr.Label input contract: full {class name: float prob} mapping.
+    assert set(out) == set(pred.class_names)
+    assert all(isinstance(v, float) for v in out.values())
+    assert np.isclose(sum(out.values()), 1.0, atol=1e-5)
+    # and the dict agrees with the Predictor's own top-k path
+    res = pred.predict(img, topk=3, conf_threshold=0.0)
+    assert res.topk[0][0] == max(out, key=out.get)
+    # the cleared-input path the UI also exercises
+    assert classify(None) == {}
 
 
 def test_gradio_gated():

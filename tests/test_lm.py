@@ -6,6 +6,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
                            ModelConfig, OptimConfig, TrainConfig)
@@ -77,6 +78,7 @@ def test_lm_learns_bigram_structure():
     assert ev["count"] == 32 * 63  # exact token count
 
 
+@pytest.mark.slow
 def test_lm_ring_attention_parity():
     base = Trainer(_cfg(MeshConfig(data=2), epochs=1))
     try:
@@ -93,6 +95,7 @@ def test_lm_ring_attention_parity():
     assert abs(base_m["accuracy"] - ring_m["accuracy"]) < 1e-6
 
 
+@pytest.mark.slow
 def test_lm_ulysses_attention_parity():
     base = Trainer(_cfg(MeshConfig(data=2), epochs=1))
     try:
@@ -121,6 +124,7 @@ def test_lm_blockwise_long_sequence():
     assert np.isfinite(m["loss"])
 
 
+@pytest.mark.slow
 def test_lm_moe_composes():
     trainer = Trainer(_cfg(MeshConfig(data=2, model=2), epochs=1,
                            moe_experts=4))
@@ -131,6 +135,7 @@ def test_lm_moe_composes():
     assert np.isfinite(m["loss"])
 
 
+@pytest.mark.slow
 def test_generation():
     from tpunet.models.lm import generate
     model = create_model(LM_CFG)
@@ -142,6 +147,7 @@ def test_generation():
     assert out.dtype == jnp.int32
 
 
+@pytest.mark.slow
 def test_kv_cache_generation_matches_full_recompute():
     """Incremental decoding (KV cache, O(L)/token) produces exactly the
     same greedy continuation as full-prefix recompute — for the dense

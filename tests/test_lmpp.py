@@ -1,0 +1,173 @@
+"""Pipeline-parallel causal LM ("lm_pp"): parity with TransformerLM,
+dp x pp training through the Trainer, pipelined dropout, and grad-accum
+composition (VERDICT round-1 item 4)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, OptimConfig, TrainConfig)
+from tpunet.models import create_model, init_variables
+from tpunet.models.lm_pp import to_transformer_lm_params
+from tpunet.parallel import make_mesh
+from tpunet.train.loop import Trainer
+
+LMPP_CFG = ModelConfig(name="lm_pp", vit_hidden=64, vit_depth=4,
+                       vit_heads=4, dropout_rate=0.0, dtype="float32",
+                       vocab_size=32, max_seq_len=32, pp_microbatches=4)
+
+
+def _tokens(b=8, t=16, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).integers(
+        0, 32, size=(b, t)), jnp.int32)
+
+
+@pytest.mark.slow
+def test_lmpp_matches_transformer_lm_logits():
+    """The stacked/pipelined math == the flax-module TransformerLM with
+    params unstacked by to_transformer_lm_params (causal mask, LN
+    upcast, tied head — all pinned)."""
+    pp_model = create_model(LMPP_CFG)
+    variables = init_variables(pp_model, jax.random.PRNGKey(0), seq_len=16)
+    lm_cfg = dataclasses.replace(LMPP_CFG, name="lm")
+    lm_model = create_model(lm_cfg)
+    lm_params = to_transformer_lm_params(variables["params"])
+    toks = _tokens()
+    a = pp_model.apply(variables, toks, train=False)
+    b = lm_model.apply({"params": lm_params}, toks, train=False)
+    assert a.shape == (8, 16, 32)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_lmpp_pipelined_matches_sequential():
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    pp_model = create_model(LMPP_CFG, mesh=mesh)
+    seq_model = create_model(LMPP_CFG, mesh=None)
+    variables = init_variables(seq_model, jax.random.PRNGKey(0),
+                               batch_size=8, seq_len=16)
+    toks = _tokens()
+    a = pp_model.apply(variables, toks, train=False)
+    b = seq_model.apply(variables, toks, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_lmpp_causality():
+    """Changing future tokens must not change past logits."""
+    model = create_model(LMPP_CFG)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=16)
+    toks = _tokens()
+    mutated = toks.at[:, 10:].set((toks[:, 10:] + 7) % 32)
+    a = model.apply(variables, toks, train=False)
+    b = model.apply(variables, mutated, train=False)
+    np.testing.assert_allclose(np.asarray(a[:, :10]),
+                               np.asarray(b[:, :10]), rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(a[:, 10:]), np.asarray(b[:, 10:]))
+
+
+def test_lmpp_dropout_is_seeded_and_active():
+    """train=True dropout: deterministic per rng, different across rngs,
+    identity at rate 0 — both sequential and pipelined paths."""
+    cfg = dataclasses.replace(LMPP_CFG, dropout_rate=0.3)
+    toks = _tokens()
+    for mesh in (None, make_mesh(MeshConfig(data=2, pipe=2))):
+        model = create_model(cfg, mesh=mesh)
+        variables = init_variables(model, jax.random.PRNGKey(0),
+                                   batch_size=8, seq_len=16)
+        run = lambda seed: np.asarray(model.apply(
+            variables, toks, train=True,
+            rngs={"dropout": jax.random.PRNGKey(seed)}))
+        np.testing.assert_array_equal(run(1), run(1))
+        assert not np.allclose(run(1), run(2))
+        # train=False ignores dropout entirely (no rng needed)
+        base = np.asarray(model.apply(variables, toks, train=False))
+        assert not np.allclose(run(1), base)
+
+
+def _cfg(mesh_cfg, accum=1, **model_kw):
+    return TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        synthetic_train_size=32, synthetic_test_size=16,
+                        seq_len=32, vocab_size=32),
+        model=dataclasses.replace(LMPP_CFG, **model_kw),
+        optim=OptimConfig(learning_rate=1e-3, grad_accum=accum),
+        mesh=mesh_cfg,
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+
+
+def _run(cfg):
+    tr = Trainer(cfg)
+    try:
+        train_m = tr.train_one_epoch(1)
+        eval_m = tr.evaluate()
+    finally:
+        tr.close()
+    return train_m, eval_m
+
+
+@pytest.mark.slow
+def test_lmpp_training_parity_with_dp_only():
+    base_t, base_e = _run(_cfg(MeshConfig(data=2)))
+    pp_t, pp_e = _run(_cfg(MeshConfig(data=2, pipe=4)))
+    assert abs(base_t["loss"] - pp_t["loss"]) < 1e-4
+    assert abs(base_e["loss"] - pp_e["loss"]) < 1e-4
+
+    # stacked block params and Adam moments sharded over 'pipe'
+    from jax.sharding import PartitionSpec as P
+    tr = Trainer(_cfg(MeshConfig(data=2, pipe=4)))
+    try:
+        assert tr.state.params["blocks_qkv_k"].sharding.spec == P("pipe")
+        mu = tr.state.opt_state[0].mu["blocks_qkv_k"]
+        assert mu.sharding.spec == P("pipe")
+    finally:
+        tr.close()
+
+
+@pytest.mark.slow
+def test_grad_accum_composes_with_pipeline():
+    """accum=2 over a dp x pp mesh gives the same loss/metrics as
+    accum=1 (no BatchNorm in the LM -> exact composition), for both
+    lm_pp and vit_pp (whose accum rejection this replaces)."""
+    base_t, _ = _run(_cfg(MeshConfig(data=2, pipe=2)))
+    acc_t, _ = _run(_cfg(MeshConfig(data=2, pipe=2), accum=2))
+    assert abs(base_t["loss"] - acc_t["loss"]) < 1e-4
+
+    vit_cfg = TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic", image_size=32, batch_size=32,
+                        synthetic_train_size=64, synthetic_test_size=32),
+        model=ModelConfig(name="vit_pp", vit_patch=4, vit_hidden=64,
+                          vit_depth=4, vit_heads=4, dropout_rate=0.0,
+                          dtype="float32", pp_microbatches=2),
+        optim=OptimConfig(learning_rate=1e-3, grad_accum=2),
+        mesh=MeshConfig(data=2, pipe=2),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    t, _ = _run(vit_cfg)
+    assert np.isfinite(t["loss"])
+
+
+def test_grad_accum_pipeline_indivisible_raises():
+    with pytest.raises(ValueError, match="pp_microbatches"):
+        Trainer(_cfg(MeshConfig(data=2, pipe=2), accum=2,
+                     pp_microbatches=8))
+
+
+def test_lmpp_rejects_unsupported_features():
+    with pytest.raises(ValueError, match="dense"):
+        create_model(dataclasses.replace(LMPP_CFG, attention="ring"))
+    with pytest.raises(ValueError, match="MoE"):
+        create_model(dataclasses.replace(LMPP_CFG, moe_experts=4))
+    with pytest.raises(ValueError, match="remat"):
+        create_model(dataclasses.replace(LMPP_CFG, remat=True))
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    with pytest.raises(ValueError, match="divisible"):
+        create_model(dataclasses.replace(LMPP_CFG, vit_depth=6), mesh=mesh)

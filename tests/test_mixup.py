@@ -27,6 +27,7 @@ def test_disabled_is_identity():
     assert float(lam) == 1.0
 
 
+@pytest.mark.slow
 def test_mixup_is_convex_combination():
     x, y = _batch()
     out, yb, lam = mixup_cutmix(jax.random.PRNGKey(1), x, y, 0.4, 0.0)
@@ -63,6 +64,7 @@ def test_cutmix_pixels_come_from_two_sources():
         assert b0[rows[0]:rows[-1] + 1, cols[0]:cols[-1] + 1].all()
 
 
+@pytest.mark.slow
 def test_both_alphas_pick_one_per_step():
     """With both alphas set, some steps mix and some cut: CutMix output
     pixels are exact copies of SOME batch row, mixup pixels (lam

@@ -29,6 +29,7 @@ def test_forward_shapes_and_dtype(model_and_vars):
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_train_mode_updates_batch_stats(model_and_vars):
     model, variables = model_and_vars
     x = jax.random.normal(jax.random.PRNGKey(1), (4, 64, 64, 3))

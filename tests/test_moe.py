@@ -6,6 +6,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
                            ModelConfig, OptimConfig, TrainConfig)
@@ -26,6 +27,7 @@ def _moe(experts=4, top_k=2, cap=1.25, dtype=jnp.float32):
     return m, {"params": variables["params"]}, x
 
 
+@pytest.mark.slow
 def test_output_shape_and_dtype():
     m, variables, x = _moe()
     y = m.apply(variables, x)
@@ -47,6 +49,7 @@ def test_aux_loss_sown_and_bounded():
     assert float(aux) < m.num_experts + 1e-5
 
 
+@pytest.mark.slow
 def test_single_expert_topk1_is_dense_mlp_through_router():
     """One expert, ample capacity: every token goes to expert 0 with
     gate 1.0, so the MoE output is a plain (batched) MLP of its single
@@ -78,6 +81,7 @@ def _cfg(mesh_cfg, **model_kw):
     )
 
 
+@pytest.mark.slow
 def test_moe_vit_params_and_trainer():
     model = create_model(MOE_CFG)
     variables = init_variables(model, jax.random.PRNGKey(0), image_size=32)
@@ -95,6 +99,7 @@ def test_moe_vit_params_and_trainer():
     assert np.isfinite(m["loss"]) and np.isfinite(e["loss"])
 
 
+@pytest.mark.slow
 def test_expert_parallel_training_parity():
     """Experts sharded over 'model' (EP) == unsharded run, same math."""
     def run(mesh_cfg):

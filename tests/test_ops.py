@@ -65,6 +65,7 @@ def test_bfloat16_accumulates_in_f32():
                                np.asarray(want), rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_gradients_match_reference():
     x = _rand((2, 14, 14, 32), 6)
     w = _rand((3, 3, 32), 7)
@@ -95,6 +96,7 @@ def test_jit_composes():
         rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_model_flag_same_params_same_logits(monkeypatch):
     """The pallas and XLA depthwise paths share one parameter tree and
     produce the same logits (ModelConfig.use_pallas_depthwise).

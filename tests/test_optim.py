@@ -115,6 +115,7 @@ def test_clip_norm_trains_and_moment_rules_still_match():
         trainer.close()
 
 
+@pytest.mark.slow
 def test_clip_norm_composes_with_zero1():
     trainer = Trainer(_lm_cfg(OptimConfig(learning_rate=3e-3,
                                           clip_norm=1.0),

@@ -40,6 +40,7 @@ def _toy(depth=4, c=8):
 
 
 @pytest.mark.parametrize("pipe,n_micro", [(2, 2), (4, 4), (2, 4), (4, 2)])
+@pytest.mark.slow
 def test_gpipe_matches_sequential(pipe, n_micro):
     params, x = _toy()
     mesh = make_mesh(MeshConfig(data=2, pipe=pipe))
@@ -58,6 +59,7 @@ def test_gpipe_single_stage_is_sequential():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpipe_gradients_match_sequential():
     params, x = _toy()
     mesh = make_mesh(MeshConfig(data=2, pipe=2))
@@ -83,6 +85,7 @@ def test_gpipe_rejects_indivisible_microbatch():
         gpipe(_stage_apply, params, x, mesh=mesh, n_micro=3)
 
 
+@pytest.mark.slow
 def test_pipelined_vit_matches_own_sequential_path():
     """Same params: pipelined forward (pipe=4) == sequential scan."""
     mesh = make_mesh(MeshConfig(data=2, pipe=4))
@@ -98,6 +101,7 @@ def test_pipelined_vit_matches_own_sequential_path():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipelined_vit_matches_dense_vit_logits():
     """vit_pp's hand-rolled block math == the flax-module dense ViT,
     with vit params mapped into the stacked layout (pins the duplicated
@@ -162,6 +166,7 @@ def _cfg(mesh_cfg, **model_kw):
     )
 
 
+@pytest.mark.slow
 def test_pp_training_parity_with_dp_only():
     def run(mesh_cfg):
         tr = Trainer(_cfg(mesh_cfg))

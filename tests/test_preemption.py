@@ -5,6 +5,7 @@ import os
 import signal
 
 import numpy as np
+import pytest
 
 from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
                            ModelConfig, OptimConfig, TrainConfig)
@@ -36,6 +37,7 @@ def _cfg(tmp_path, epochs=3):
     )
 
 
+@pytest.mark.slow
 def test_preempted_run_saves_state_and_resumes(tmp_path):
     trainer = Trainer(_cfg(tmp_path))
     real_epoch = trainer.train_one_epoch
@@ -50,9 +52,18 @@ def test_preempted_run_saves_state_and_resumes(tmp_path):
         history = trainer.train()
     finally:
         trainer.close()
-    assert history == []          # preempted epoch logs no record
+    assert history == []          # preempted epoch logs no completed record
     step_after_one_epoch = trainer.global_step
     assert step_after_one_epoch == 2  # 64 / 32
+
+    # ... but metrics.jsonl self-describes the interruption: a
+    # partial: true row (no eval fields — the eval pass was skipped).
+    with open(os.path.join(str(tmp_path), "metrics.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    assert len(rows) == 1 and rows[0]["partial"] is True
+    assert rows[0]["epoch"] == 1 and rows[0]["step"] == 2
+    assert "test_accuracy" not in rows[0]
+    assert np.isfinite(rows[0]["train_loss"])
 
     resumed = Trainer(_cfg(tmp_path).replace(
         checkpoint=CheckpointConfig(directory=str(tmp_path), resume=True,
@@ -69,6 +80,7 @@ def test_preempted_run_saves_state_and_resumes(tmp_path):
     assert np.isfinite(m["loss"])
 
 
+@pytest.mark.slow
 def test_metrics_jsonl_written(tmp_path):
     trainer = Trainer(_cfg(tmp_path, epochs=2))
     try:

@@ -79,6 +79,7 @@ def _train_on_cycle(tmp_path, epochs=8):
     return cfg, history
 
 
+@pytest.mark.slow
 def test_text_lm_end_to_end_and_generation(tmp_path):
     cfg, history = _train_on_cycle(tmp_path)
     # the cycle's next byte is a function of the current byte -> a tiny
@@ -94,6 +95,7 @@ def test_text_lm_end_to_end_and_generation(tmp_path):
     assert match > 0.8, (out, expect)
 
 
+@pytest.mark.slow
 def test_generate_cli_main(tmp_path, capsys):
     _train_on_cycle(tmp_path, epochs=2)
     from tpunet.infer import generate as gen
@@ -105,6 +107,7 @@ def test_generate_cli_main(tmp_path, capsys):
     assert out.startswith("abc") and len(out) == 11
 
 
+@pytest.mark.slow
 def test_generate_cli_token_vocab_prompt(tmp_path, capsys):
     """Non-byte vocabs take the prompt as space-separated token ids —
     and reject anything else instead of silently generating from 0."""
@@ -146,6 +149,7 @@ def test_cli_flags(tmp_path):
     assert cfg.data.text_path == "corpus.txt"
 
 
+@pytest.mark.slow
 def test_top_k_and_top_p_sampling(tmp_path):
     """top_k=1 equals greedy regardless of temperature; top_p strictly
     inside (0,1) also constrains to high-probability tokens."""

@@ -77,6 +77,7 @@ def _one_epoch(mesh_cfg, **model_kw):
     return train_m, eval_m
 
 
+@pytest.mark.slow
 def test_tp_training_parity():
     base_t, base_e = _one_epoch(MeshConfig(data=2))
     tp_t, tp_e = _one_epoch(MeshConfig(data=2, model=2))
@@ -84,6 +85,7 @@ def test_tp_training_parity():
     assert abs(base_e["accuracy"] - tp_e["accuracy"]) < 1e-6
 
 
+@pytest.mark.slow
 def test_zero1_shards_moments_and_keeps_parity():
     """ZeRO-1: Adam moments shard over 'data', params stay replicated,
     training math unchanged."""
@@ -121,6 +123,7 @@ def test_zero1_composes_with_tp():
         trainer.close()
 
 
+@pytest.mark.slow
 def test_dp_sp_tp_combined_training_parity():
     """The flagship composition: data=2 x seq=2 x model=2 over 8 devices,
     ring attention + Megatron-style param sharding, exact same math as

@@ -53,6 +53,7 @@ def test_steplr_schedule_matches_reference():
     assert np.isclose(sched(99), 1e-5)
 
 
+@pytest.mark.slow
 def test_train_loss_decreases(tmp_path, tiny_dataset):
     cfg = tiny_config(tmp_path, epochs=3)
     t = Trainer(cfg, dataset=tiny_dataset)
@@ -71,6 +72,7 @@ def test_eval_counts_exact(tmp_path, tiny_dataset):
     assert m["count"] == 48  # exact despite batch padding (48 = 3*16)
 
 
+@pytest.mark.slow
 def test_metrics_identical_across_mesh_sizes(tmp_path, tiny_dataset):
     """Same global batch => same loss whether on 1 device or 8 (the
     reference validated distributed correctness by accuracy parity)."""

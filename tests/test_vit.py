@@ -22,6 +22,7 @@ def _vars(cfg=VIT_CFG, size=32):
                                  image_size=size)
 
 
+@pytest.mark.slow
 def test_forward_shapes_and_no_batch_stats():
     model, variables = _vars()
     assert "batch_stats" not in variables
@@ -77,6 +78,7 @@ def _train_cfg(**model_kw):
     )
 
 
+@pytest.mark.slow
 def test_remat_same_logits_and_gradients():
     """nn.remat blocks: identical forward and grads, less live memory."""
     plain = create_model(VIT_CFG)
@@ -101,6 +103,7 @@ def test_remat_same_logits_and_gradients():
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_vit_trains_through_trainer():
     from tpunet.train.loop import Trainer
     trainer = Trainer(_train_cfg())
@@ -115,6 +118,7 @@ def test_vit_trains_through_trainer():
     assert ev["count"] == 32
 
 
+@pytest.mark.slow
 def test_vit_ring_attention_through_trainer_matches_dense():
     """Full jitted train step with ring attention over a ('data','seq')
     mesh == the dense-attention step on the same data (task: sequence

@@ -318,7 +318,7 @@ def build_argparser() -> argparse.ArgumentParser:
                         "pretrained=True, :137)")
     p.add_argument("--model", default=None,
                    choices=["mobilenet_v2", "vit", "vit_tiny", "vit_small",
-                            "vit_base", "vit_pp", "lm"])
+                            "vit_base", "vit_pp", "lm", "lm_pp"])
     p.add_argument("--seq-len", type=int, default=None,
                    help="sequence length for token datasets (model lm)")
     p.add_argument("--max-seq-len", type=int, default=None,

@@ -19,6 +19,22 @@ from typing import Optional
 from tpunet.infer.predict import Predictor
 
 
+def make_classify(predictor: Predictor):
+    """The serving function the web UI calls: PIL image (or None) ->
+    {class name: probability} dict, the input format of gr.Label (which
+    renders the top-3 — reference GROUP03.pdf pp.22-23). Module-level so
+    it is testable without gradio installed."""
+
+    def classify(img):
+        if img is None:
+            return {}
+        probs = predictor.predict_probs(img)
+        return {name: float(p)
+                for name, p in zip(predictor.class_names, probs)}
+
+    return classify
+
+
 def build_interface(predictor: Optional[Predictor] = None,
                     checkpoint_dir: str = "checkpoints"):
     try:
@@ -29,13 +45,7 @@ def build_interface(predictor: Optional[Predictor] = None,
             "web app, or use tpunet.infer.Predictor directly") from e
 
     predictor = predictor or Predictor(checkpoint_dir=checkpoint_dir)
-
-    def classify(img):
-        if img is None:
-            return {}
-        probs = predictor.predict_probs(img)
-        return {name: float(p)
-                for name, p in zip(predictor.class_names, probs)}
+    classify = make_classify(predictor)
 
     return gr.Interface(
         fn=classify,

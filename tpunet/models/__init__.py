@@ -30,6 +30,9 @@ def create_model(cfg: ModelConfig, mesh=None):
     if cfg.name == "lm":
         from tpunet.models import lm
         return lm.create_model(cfg, mesh=mesh)
+    if cfg.name == "lm_pp":
+        from tpunet.models import lm_pp
+        return lm_pp.create_model(cfg, mesh=mesh)
     if cfg.name == "vit" or cfg.name in VIT_PRESETS:
         return vit.create_model(cfg, mesh=mesh)
     raise ValueError(f"unknown model {cfg.name!r}")

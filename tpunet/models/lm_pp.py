@@ -1,0 +1,196 @@
+"""Pipeline-parallel causal LM ("lm_pp").
+
+The LM family is where pipeline parallelism earns its keep (depth grows
+with model scale while the vision models stay shallow), so the decoder
+gets the same treatment as tpunet/models/vit_pp.py: encoder blocks as
+*stacked functional parameters* (leading ``depth`` dim, sharded over the
+mesh 'pipe' axis by the path rule in tpunet/parallel/tp.py) streamed
+through the GPipe executor (tpunet/parallel/pp.py) — one jitted SPMD
+program, activations hopping stage-to-stage via ``lax.ppermute``.
+
+Architecture matches tpunet/models/lm.py's TransformerLM: token
+embedding + learned positions -> pre-LN causal blocks -> final LN ->
+logits tied to the embedding transpose. Causality comes from the dense
+attention mask inside block_apply (causal=True); sequence stays whole
+per device (compose with 'data' for DP x PP; ring/Ulysses SP cannot
+nest inside the pipeline's shard_map, same restriction as vit_pp).
+
+Dropout is fully supported: the train step's dropout rng threads
+through gpipe, folded per (tick, stage, layer). Grad accumulation
+composes too — the accumulation scan in steps.py wraps the whole
+pipelined program (microbatching in TIME over microbatching in STAGES).
+
+With pipe == 1 the stacked params run as a plain lax.scan over layers —
+the same math, which the parity tests assert. No KV-cache decode path:
+generation/serving loads lm_pp checkpoints into the (architecturally
+identical) TransformerLM via tpunet/models/registry conversion, or
+simply evaluates full-prefix; the reference has no LM serving at all
+(SURVEY.md section 0 — this whole family is beyond parity).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpunet.config import ModelConfig
+from tpunet.models.vit_pp import (_dropout, _stacked_lecun_normal,
+                                  block_apply)
+from tpunet.parallel.pp import gpipe
+
+
+class PipelinedLM(nn.Module):
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32, pipelined."""
+
+    vocab_size: int = 256
+    hidden: int = 192
+    depth: int = 6
+    heads: int = 3
+    mlp_ratio: float = 4.0
+    max_len: int = 1024
+    n_micro: int = 4
+    dropout_rate: float = 0.0
+    mesh: Any = None                   # jax.sharding.Mesh or None
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    input_kind = "tokens"              # init_variables dispatch
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        if self.hidden % self.heads:
+            raise ValueError(f"hidden {self.hidden} not divisible by "
+                             f"{self.heads} heads")
+        b, t = tokens.shape
+        if t > self.max_len:
+            raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
+        embed = nn.Embed(self.vocab_size, self.hidden,
+                         embedding_init=nn.initializers.normal(stddev=0.02),
+                         param_dtype=self.param_dtype, name="embed")
+        x = embed(tokens).astype(self.dtype)
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
+                         (1, self.max_len, self.hidden), self.param_dtype)
+        x = x + pos[:, :t].astype(self.dtype)
+
+        rate = self.dropout_rate if train else 0.0
+        key = self.make_rng("dropout") if rate > 0.0 else None
+        if key is not None:
+            x = _dropout(x, rate, self.make_rng("dropout"))
+
+        ln_ones = nn.initializers.ones
+        zeros = nn.initializers.zeros
+        winit = _stacked_lecun_normal
+        L, C, H = self.depth, self.hidden, int(self.hidden * self.mlp_ratio)
+        blocks = {
+            "ln1s": self.param("blocks_ln1s", ln_ones, (L, C),
+                               self.param_dtype),
+            "ln1b": self.param("blocks_ln1b", zeros, (L, C),
+                               self.param_dtype),
+            "qkv_k": self.param("blocks_qkv_k", winit, (L, C, 3 * C),
+                                self.param_dtype),
+            "qkv_b": self.param("blocks_qkv_b", zeros, (L, 3 * C),
+                                self.param_dtype),
+            "out_k": self.param("blocks_out_k", winit, (L, C, C),
+                                self.param_dtype),
+            "out_b": self.param("blocks_out_b", zeros, (L, C),
+                                self.param_dtype),
+            "ln2s": self.param("blocks_ln2s", ln_ones, (L, C),
+                               self.param_dtype),
+            "ln2b": self.param("blocks_ln2b", zeros, (L, C),
+                               self.param_dtype),
+            "fc1_k": self.param("blocks_fc1_k", winit, (L, C, H),
+                                self.param_dtype),
+            "fc1_b": self.param("blocks_fc1_b", zeros, (L, H),
+                                self.param_dtype),
+            "fc2_k": self.param("blocks_fc2_k", winit, (L, H, C),
+                                self.param_dtype),
+            "fc2_b": self.param("blocks_fc2_b", zeros, (L, C),
+                                self.param_dtype),
+        }
+        blocks = jax.tree_util.tree_map(
+            lambda a: a.astype(self.dtype), blocks)
+        heads = self.heads
+
+        def stage_apply(params, xs, k=None):
+            def body(carry, inp):
+                pl, i = inp
+                lk = (jax.random.fold_in(k, i) if k is not None else None)
+                return block_apply(pl, carry, heads=heads, causal=True,
+                                   dropout_rate=rate, key=lk), None
+            idx = jnp.arange(jax.tree_util.tree_leaves(params)[0].shape[0])
+            out, _ = jax.lax.scan(body, xs, (params, idx))
+            return out
+
+        if self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1:
+            x = gpipe(stage_apply, blocks, x, mesh=self.mesh,
+                      n_micro=self.n_micro, key=key)
+        else:
+            x = (stage_apply(blocks, x) if key is None
+                 else stage_apply(blocks, x, key))
+
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln")(x)
+        logits = embed.attend(x.astype(self.param_dtype))
+        return logits.astype(jnp.float32)
+
+
+def to_transformer_lm_params(params: dict) -> dict:
+    """Unstack a PipelinedLM param tree into TransformerLM's layout
+    (block{i:02d}/attn/..., tpunet/models/lm.py) — the two are the same
+    architecture, so lm_pp training checkpoints serve through the
+    TransformerLM KV-cache generation path."""
+    out = {"embed": params["embed"], "pos_embed": params["pos_embed"],
+           "ln": params["ln"]}
+    L = params["blocks_qkv_k"].shape[0]
+    for i in range(L):
+        out[f"block{i:02d}"] = {
+            "ln1": {"scale": params["blocks_ln1s"][i],
+                    "bias": params["blocks_ln1b"][i]},
+            "attn": {"qkv": {"kernel": params["blocks_qkv_k"][i],
+                             "bias": params["blocks_qkv_b"][i]},
+                     "out": {"kernel": params["blocks_out_k"][i],
+                             "bias": params["blocks_out_b"][i]}},
+            "ln2": {"scale": params["blocks_ln2s"][i],
+                    "bias": params["blocks_ln2b"][i]},
+            "mlp": {"fc1": {"kernel": params["blocks_fc1_k"][i],
+                            "bias": params["blocks_fc1_b"][i]},
+                    "fc2": {"kernel": params["blocks_fc2_k"][i],
+                            "bias": params["blocks_fc2_b"][i]}},
+        }
+    return out
+
+
+def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
+    """Build a PipelinedLM; unsupported 'lm' features fail loudly."""
+    if cfg.attention != "dense":
+        raise ValueError(
+            f"lm_pp supports dense (causal) attention only (got "
+            f"{cfg.attention!r}); ring/ulysses cannot nest inside the "
+            "pipeline's shard_map")
+    if cfg.moe_experts > 0:
+        raise ValueError("lm_pp does not support MoE blocks")
+    if cfg.remat:
+        raise ValueError("lm_pp does not support --remat (the pipeline "
+                         "scan already bounds activation memory per "
+                         "stage)")
+    if mesh is not None:
+        stages = mesh.shape.get("pipe", 1)
+        if stages > 1 and cfg.vit_depth % stages:
+            raise ValueError(f"vit_depth {cfg.vit_depth} not divisible "
+                             f"by {stages} pipeline stages")
+    return PipelinedLM(
+        vocab_size=cfg.vocab_size,
+        hidden=cfg.vit_hidden,
+        depth=cfg.vit_depth,
+        heads=cfg.vit_heads,
+        mlp_ratio=cfg.vit_mlp_ratio,
+        max_len=cfg.max_seq_len,
+        n_micro=cfg.pp_microbatches,
+        dropout_rate=cfg.dropout_rate,
+        mesh=mesh,
+        dtype=jnp.dtype(cfg.dtype),
+        param_dtype=jnp.dtype(cfg.param_dtype),
+    )

@@ -16,9 +16,10 @@ Patch embed, final LN and the classifier head are tiny; they run
 replicated on every pipe stage rather than being assigned to first/last
 stages (standard trick — keeps the pipeline body uniform).
 
-Differences from the dense ViT (documented, deliberate): no dropout
-inside pipelined blocks, dense attention only (ring attention's own
-shard_map cannot nest inside the pipeline's).
+Differences from the dense ViT (documented, deliberate): dense attention
+only (ring attention's own shard_map cannot nest inside the pipeline's).
+Dropout IS supported: a PRNG key threads through the GPipe executor,
+folded per (tick, stage, layer) — see block_apply.
 """
 
 from __future__ import annotations
@@ -56,17 +57,36 @@ def _layer_norm(x, scale, bias, eps=1e-6):
             + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def block_apply(p, x, *, heads):
-    """One pre-LN encoder block from a dict of per-layer params."""
+def _dropout(x, rate, key):
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def block_apply(p, x, *, heads, causal=False, dropout_rate=0.0, key=None):
+    """One pre-LN encoder block from a dict of per-layer params.
+
+    Mirrors tpunet/models/vit.py's EncoderBlock: dropout (when
+    ``dropout_rate > 0`` and ``key`` is given) applies after the
+    attention out-projection and after the MLP's second dense, exactly
+    the flax module's placements; ``causal=True`` is the LM family's
+    autoregressive mask."""
     mb, t, c = x.shape
     y = _layer_norm(x, p["ln1s"], p["ln1b"])
     qkv = y @ p["qkv_k"] + p["qkv_b"]
     qkv = qkv.reshape(mb, t, 3, heads, c // heads)
-    a = dense_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
-    x = x + a.reshape(mb, t, c) @ p["out_k"] + p["out_b"]
+    a = dense_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                        causal=causal)
+    a = a.reshape(mb, t, c) @ p["out_k"] + p["out_b"]
+    if dropout_rate > 0.0 and key is not None:
+        ka, km = jax.random.split(key)
+        a = _dropout(a, dropout_rate, ka)
+    x = x + a
     y = _layer_norm(x, p["ln2s"], p["ln2b"])
     h = nn.gelu(y @ p["fc1_k"] + p["fc1_b"])
-    return x + h @ p["fc2_k"] + p["fc2_b"]
+    h = h @ p["fc2_k"] + p["fc2_b"]
+    if dropout_rate > 0.0 and key is not None:
+        h = _dropout(h, dropout_rate, km)
+    return x + h
 
 
 class PipelinedViT(nn.Module):
@@ -79,6 +99,7 @@ class PipelinedViT(nn.Module):
     heads: int = 4
     mlp_ratio: float = 4.0
     n_micro: int = 4
+    dropout_rate: float = 0.0
     mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -135,18 +156,27 @@ class PipelinedViT(nn.Module):
         blocks = jax.tree_util.tree_map(
             lambda a: a.astype(self.dtype), blocks)
         heads = self.heads
+        rate = self.dropout_rate if train else 0.0
+        key = self.make_rng("dropout") if rate > 0.0 else None
+        if key is not None:
+            x = _dropout(x, rate, self.make_rng("dropout"))
 
-        def stage_apply(params, xs):
-            def body(carry, pl):
-                return block_apply(pl, carry, heads=heads), None
-            out, _ = jax.lax.scan(body, xs, params)
+        def stage_apply(params, xs, k=None):
+            def body(carry, inp):
+                pl, i = inp
+                lk = (jax.random.fold_in(k, i) if k is not None else None)
+                return block_apply(pl, carry, heads=heads,
+                                   dropout_rate=rate, key=lk), None
+            idx = jnp.arange(jax.tree_util.tree_leaves(params)[0].shape[0])
+            out, _ = jax.lax.scan(body, xs, (params, idx))
             return out
 
         if self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1:
             x = gpipe(stage_apply, blocks, x, mesh=self.mesh,
-                      n_micro=self.n_micro)
+                      n_micro=self.n_micro, key=key)
         else:
-            x = stage_apply(blocks, x)
+            x = (stage_apply(blocks, x) if key is None
+                 else stage_apply(blocks, x, key))
 
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln")(x)
@@ -159,9 +189,7 @@ class PipelinedViT(nn.Module):
 
 
 def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
-    """Build a PipelinedViT. Unsupported 'vit' features fail loudly
-    (dropout is the documented exception: pipelined blocks run without
-    it; the config field only affects the dense ViT)."""
+    """Build a PipelinedViT. Unsupported 'vit' features fail loudly."""
     if cfg.attention != "dense":
         raise ValueError(
             f"vit_pp supports dense attention only (got "
@@ -182,6 +210,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
         heads=cfg.vit_heads,
         mlp_ratio=cfg.vit_mlp_ratio,
         n_micro=cfg.pp_microbatches,
+        dropout_rate=cfg.dropout_rate,
         mesh=mesh,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),

@@ -48,6 +48,7 @@ remove the O(T²) HBM traffic that binds the dense backward
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -613,9 +614,15 @@ def _entry(prim, q, k, v, causal, scale, block_q, block_k, interpret):
         # block is honored: tests drive the kernel with block 16/32.)
         return dense_attention(q, k, v, causal=causal, scale=scale)
     if interpret is None:
-        if jax.default_backend() != "tpu":
+        if os.environ.get("TPUNET_FLASH_INTERPRET",
+                          "").lower() not in ("", "0", "false"):
+            # Force the Pallas interpreter off-TPU (driver dryrun/tests:
+            # exercises the real kernel body, not the dense fallback).
+            interpret = True
+        elif jax.default_backend() != "tpu":
             return dense_attention(q, k, v, causal=causal, scale=scale)
-        interpret = False
+        else:
+            interpret = False
     return prim(q, k, v, causal, scale, block_q, block_k, interpret)
 
 

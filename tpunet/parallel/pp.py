@@ -32,7 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def gpipe(stage_apply: Callable, stacked_params, x, *,
           mesh: Mesh, n_micro: int, axis_name: str = "pipe",
-          data_axis: str = "data"):
+          data_axis: str = "data", key=None):
     """Run ``x`` through all pipeline stages.
 
     stage_apply(local_params, x_micro) applies one stage's layer stack
@@ -40,11 +40,17 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     of ``local_params`` is the device-local slice (leading dim
     total_layers/S) of ``stacked_params``.
 
+    ``key`` (optional PRNG key) enables stochastic stages (dropout):
+    stage_apply is then called as stage_apply(local_params, x_micro,
+    key) with a key folded per (tick, stage) — unique randomness per
+    microbatch per stage, identical math under AD.
+
     x: [B, T, C] (batch sharded over ``data_axis``); returns [B, T, C].
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
-        return stage_apply(stacked_params, x)
+        return (stage_apply(stacked_params, x) if key is None
+                else stage_apply(stacked_params, x, key))
 
     for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
         if leaf.shape[0] % n_stages:
@@ -56,17 +62,39 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     p_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     x_spec = P(data_axis, None, None)
 
+    if key is None:
+        body = functools.partial(_gpipe_body, stage_apply,
+                                 n_micro=n_micro, axis_name=axis_name)
+        in_specs = (p_specs, x_spec)
+        args = (stacked_params, x)
+    else:
+        body = functools.partial(_gpipe_body_keyed, stage_apply,
+                                 n_micro=n_micro, axis_name=axis_name)
+        in_specs = (p_specs, x_spec, P())      # key replicated
+        args = (stacked_params, x, key)
+
     fn = jax.shard_map(
-        functools.partial(_gpipe_body, stage_apply, n_micro=n_micro,
-                          axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(p_specs, x_spec),
-        out_specs=x_spec,
+        body, mesh=mesh, in_specs=in_specs, out_specs=x_spec,
         check_vma=False)
-    return fn(stacked_params, x)
+    return fn(*args)
 
 
-def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name):
+def _gpipe_body_keyed(stage_apply, local_params, xl, key, *, n_micro,
+                      axis_name):
+    """_gpipe_body with a per-(tick, stage) folded PRNG key."""
+    s = jax.lax.axis_index(axis_name)
+
+    def keyed_apply(params, x, step):
+        return stage_apply(params, x,
+                           jax.random.fold_in(jax.random.fold_in(key,
+                                                                 step), s))
+
+    return _gpipe_body(keyed_apply, local_params, xl, n_micro=n_micro,
+                       axis_name=axis_name, pass_step=True)
+
+
+def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
+                pass_step=False):
     s = jax.lax.axis_index(axis_name)
     n_stages = jax.lax.psum(1, axis_name)
     bl, t, c = xl.shape
@@ -86,7 +114,8 @@ def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name):
                         jax.lax.dynamic_index_in_dim(xm, mc, 0,
                                                      keepdims=False),
                         act_in)
-        y = stage_apply(local_params, inp)
+        y = (stage_apply(local_params, inp, step) if pass_step
+             else stage_apply(local_params, inp))
         y = jnp.where(valid, y, jnp.zeros_like(y))
         is_last = s == n_stages - 1
         outbuf = jax.lax.dynamic_update_index_in_dim(

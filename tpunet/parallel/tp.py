@@ -50,9 +50,9 @@ VIT_TP_RULES: Rules = (
 )
 
 
-# Pipeline-parallel ViT: every stacked block param ([depth, ...],
-# tpunet/models/vit_pp.py) shards its leading layer dim over 'pipe' —
-# contiguous chunks, i.e. one stage's layers per device.
+# Pipeline-parallel models (vit_pp, lm_pp): every stacked block param
+# ([depth, ...]) shards its leading layer dim over 'pipe' — contiguous
+# chunks, i.e. one stage's layers per device.
 VIT_PP_RULES: Rules = (
     (r"blocks_\w+$", P("pipe")),
 )
@@ -116,7 +116,7 @@ def rules_for(cfg: ModelConfig, mesh: Mesh = None,
     ``zero1`` appends ZERO1_RULES; ``fsdp`` appends FSDP_RULES (which
     subsume ZeRO-1: moments follow their parameter's data-axis shard).
     """
-    if cfg.name == "vit_pp":
+    if cfg.name in ("vit_pp", "lm_pp"):
         rules = VIT_PP_RULES
     elif (cfg.name == "vit" or cfg.name.startswith("vit_")
           or cfg.name == "lm"):

@@ -111,7 +111,8 @@ def create_train_state(model_cfg: ModelConfig, optim_cfg: OptimConfig,
     # initializes with batch 1.
     init_batch = 1
     if mesh is not None:
-        if model_cfg.name == "vit_pp" and mesh.shape.get("pipe", 1) > 1:
+        if (model_cfg.name in ("vit_pp", "lm_pp")
+                and mesh.shape.get("pipe", 1) > 1):
             init_batch = mesh.shape["data"] * model_cfg.pp_microbatches
         elif model_cfg.attention in ("ring", "ulysses"):
             init_batch = mesh.shape["data"]
