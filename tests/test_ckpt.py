@@ -58,6 +58,7 @@ def test_resume_continues_from_epoch(tmp_path, tiny_dataset):  # noqa: F811
     t2.ckpt.close()
 
 
+@pytest.mark.slow
 def test_fresh_run_ignores_missing_checkpoint(tmp_path, tiny_dataset):  # noqa: F811
     cfg = _cfg(tmp_path, epochs=1).replace(
         checkpoint=CheckpointConfig(directory=str(tmp_path / "none"),

@@ -67,6 +67,7 @@ def test_eval_only_flag_parses():
     assert cfg.eval_only
 
 
+@pytest.mark.slow
 def test_eval_only_evaluates_best_checkpoint(tmp_path):
     """--eval-only on a trained dir reproduces the best test accuracy
     without training; on an empty dir it raises cleanly."""

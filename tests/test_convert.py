@@ -53,6 +53,7 @@ def test_logit_parity(torch_model):
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_head_swap_on_class_mismatch(torch_model):
     # ImageNet checkpoints have a 1000-way head; the converter must keep
     # the fresh 10-way head (reference head swap, :138-139).
@@ -79,6 +80,7 @@ def test_ddp_module_prefix_stripped(torch_model):
     assert p["stem"]["conv"]["kernel"].shape == (3, 3, 3, 32)
 
 
+@pytest.mark.slow
 def test_export_round_trips_and_loads_into_torch_strict():
     """export_torch_state_dict is the exact inverse of the importer, and
     the exported dict satisfies torch load_state_dict(strict=True) with

@@ -99,6 +99,7 @@ def test_no_download_flag_plumbs_through():
     assert config_from_args(["--no-download"]).data.download is False
 
 
+@pytest.mark.slow
 def test_pretrained_auto_resolves_in_trainer(tmp_path, monkeypatch):
     """--pretrained auto resolves through ensure_mobilenet_v2_weights
     inside the Trainer (process-0-gated); with downloads disabled and no

@@ -131,6 +131,7 @@ def test_fsdp_gather_layout_preserves_tp_compute_sharding():
 # ----------------------------------------------------------- end-to-end
 
 
+@pytest.mark.slow
 def test_fsdp_shards_params_and_moments_and_keeps_parity():
     base_t, base_e, base_p = _run(_vit_cfg(MeshConfig(data=8)))
 
@@ -203,6 +204,7 @@ def test_fsdp_mobilenet_smoke():
 # ---------------------------------------------------- grad accumulation
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_full_batch_lm():
     """No augmentation and no dropout in the LM path -> accumulated
     microbatch gradients must reproduce the full-batch update exactly

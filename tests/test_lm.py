@@ -19,6 +19,7 @@ LM_CFG = ModelConfig(name="lm", vit_hidden=64, vit_depth=2, vit_heads=4,
                      max_seq_len=64)
 
 
+@pytest.mark.slow
 def test_forward_shape_and_causality():
     model = create_model(LM_CFG)
     variables = init_variables(model, jax.random.PRNGKey(0), seq_len=16)
@@ -63,6 +64,7 @@ def _cfg(mesh_cfg, epochs=3, **model_kw):
     )
 
 
+@pytest.mark.slow
 def test_lm_learns_bigram_structure():
     trainer = Trainer(_cfg(MeshConfig(data=2)))
     try:

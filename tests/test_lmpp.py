@@ -58,6 +58,7 @@ def test_lmpp_pipelined_matches_sequential():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_lmpp_causality():
     """Changing future tokens must not change past logits."""
     model = create_model(LMPP_CFG)
@@ -71,6 +72,7 @@ def test_lmpp_causality():
     assert not np.allclose(np.asarray(a[:, 10:]), np.asarray(b[:, 10:]))
 
 
+@pytest.mark.slow
 def test_lmpp_dropout_is_seeded_and_active():
     """train=True dropout: deterministic per rng, different across rngs,
     identity at rate 0 — both sequential and pipelined paths."""
@@ -155,6 +157,7 @@ def test_grad_accum_composes_with_pipeline():
     assert np.isfinite(t["loss"])
 
 
+@pytest.mark.slow
 def test_grad_accum_pipeline_indivisible_raises():
     with pytest.raises(ValueError, match="pp_microbatches"):
         Trainer(_cfg(MeshConfig(data=2, pipe=2), accum=2,

@@ -21,6 +21,7 @@ def test_param_count_matches_reference(model_and_vars):
     assert num_params(variables["params"]) == 2_236_682
 
 
+@pytest.mark.slow
 def test_forward_shapes_and_dtype(model_and_vars):
     model, variables = model_and_vars
     x = jnp.zeros((2, 64, 64, 3), jnp.float32)

@@ -34,6 +34,7 @@ def test_output_shape_and_dtype():
     assert y.shape == x.shape and y.dtype == x.dtype
 
 
+@pytest.mark.slow
 def test_output_finite_with_ample_capacity():
     m, variables, x = _moe(cap=4.0)
     y = m.apply(variables, x)
@@ -115,6 +116,7 @@ def test_expert_parallel_training_parity():
     assert abs(base["accuracy"] - ep["accuracy"]) < 1e-6
 
 
+@pytest.mark.slow
 def test_ep_shardings_applied():
     from jax.sharding import PartitionSpec as P
 

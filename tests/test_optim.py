@@ -182,6 +182,7 @@ def test_ema_disabled_is_empty_and_eval_uses_params():
         trainer.close()
 
 
+@pytest.mark.slow
 def test_ema_covers_batch_stats_for_bn_models():
     """BatchNorm models must evaluate/save EMA params WITH EMA running
     stats — pairing EMA weights with live stats mismatches the

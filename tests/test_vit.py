@@ -52,6 +52,7 @@ def test_indivisible_patch_raises():
         model.apply(variables, jnp.zeros((1, 30, 30, 3)), train=False)
 
 
+@pytest.mark.slow
 def test_blockwise_attention_matches_dense():
     dense_model, variables = _vars()
     bw_cfg = dataclasses.replace(VIT_CFG, attention="blockwise",
