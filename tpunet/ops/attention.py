@@ -98,22 +98,32 @@ def _finalize(m, l, acc, dtype) -> jax.Array:
 
 def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
-                    scale: Optional[float] = None) -> jax.Array:
-    """Plain softmax attention, float32 accumulation. BTHD layout."""
+                    scale: Optional[float] = None,
+                    segment_ids=None) -> jax.Array:
+    """Plain softmax attention, float32 accumulation. BTHD layout.
+
+    ``segment_ids``: optional (q_seg [B,Tq], kv_seg [B,Tk]) int pair for
+    packed sequences — a query attends only to same-segment keys."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
-    mask = None
+    mask = None                                    # [B, Tq, Tk] or None
     if causal:
         tq, tk = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-        s = jnp.where(mask[None, None], s, _NEG_INF)
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)[None]
+    if segment_ids is not None:
+        q_seg, kv_seg = segment_ids
+        seg = q_seg[:, :, None] == kv_seg[:, None, :]
+        mask = seg if mask is None else mask & seg
+    if mask is not None:
+        s = jnp.where(mask[:, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if mask is not None:
-        # Rows with no valid key (tq > tk top rows) get zeros, matching
-        # the l == 0 convention of the blockwise/ring variants — softmax
-        # alone would attend uniformly, leaking masked values.
-        p = jnp.where(mask.any(-1)[None, None, :, None], p, 0.0)
+        # Rows with no valid key (tq > tk top rows, orphan segments) get
+        # zeros, matching the l == 0 convention of the blockwise/ring
+        # variants — softmax alone would attend uniformly, leaking
+        # masked values.
+        p = jnp.where(mask.any(-1)[:, None, :, None], p, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)

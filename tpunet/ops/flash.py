@@ -59,32 +59,69 @@ from tpunet.ops.attention import (_NEG_INF, _divisor_block,
                                   dense_attention)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *refs,
+def _tri_qi_ki(t):
+    """Invert the row-major lower-triangle linearization: step t ->
+    (qi, ki) with ki <= qi; t = qi*(qi+1)/2 + ki. Float sqrt with an
+    exact integer correction (sqrt rounding can be off by one at the
+    triangular-number boundaries)."""
+    qi = ((jnp.sqrt(8.0 * t.astype(jnp.float32) + 1.0) - 1.0) / 2.0
+          ).astype(jnp.int32)
+    qi = jnp.where(t < qi * (qi + 1) // 2, qi - 1, qi)
+    qi = jnp.where(t >= (qi + 1) * (qi + 2) // 2, qi + 1, qi)
+    return qi, t - qi * (qi + 1) // 2
+
+
+def _use_tri(causal, tq, tk, bq, bk) -> bool:
+    """Triangular-grid eligibility: causal SELF-attention with square
+    blocks — every diagonal block is then partially valid and every
+    sub-diagonal block fully valid, so the lower triangle enumerates
+    exactly the needed (qi, ki) pairs."""
+    return causal and tq == tk and bq == bk
+
+
+def _seg_mask(qseg_ref, kseg_ref):
+    """[bq, bk] same-segment mask from the lane-broadcast q segment ids
+    ([bq, 128], read [:, :1]) and sublane-broadcast kv segment ids
+    ([8, bk], read [:1, :]) — the stock TPU flash kernel's layouts."""
+    return qseg_ref[0, :, :1] == kseg_ref[0, :1, :]
+
+
+def _kernel(q_ref, k_ref, v_ref, *refs,
             scale: float, causal: bool, bq: int, bk: int, nk: int,
-            tq: int, tk: int, with_lse: bool):
-    # The lse output exists only on the residual (training-forward)
-    # variant: the forward-only path skips its HBM writes entirely.
+            tq: int, tk: int, with_lse: bool, tri: bool,
+            with_segments: bool):
+    # Optional operands/outputs resolved by arity: segment-id inputs
+    # come after v; the lse output exists only on the residual
+    # (training-forward) variant — the forward-only path skips its HBM
+    # writes entirely.
+    if with_segments:
+        qseg_ref, kseg_ref, *refs = refs
+    o_ref, *refs = refs
     if with_lse:
         lse_ref, m_ref, l_ref, acc_ref = refs
     else:
         m_ref, l_ref, acc_ref = refs
-    qi = pl.program_id(2)     # program ids are hoisted out of the
-    ki = pl.program_id(3)     # pl.when bodies (cond sub-traces cannot
-                              # bind pallas primitives in interpret mode)
+    if tri:
+        # Fused lower-triangular grid: only needed (qi, ki) pairs exist,
+        # no dead steps at all (VERDICT r1 item 5).
+        qi, ki = _tri_qi_ki(pl.program_id(2))
+        last, needed = ki == qi, True
+    else:
+        qi = pl.program_id(2)  # program ids are hoisted out of the
+        ki = pl.program_id(3)  # pl.when bodies (cond sub-traces cannot
+                               # bind pallas primitives in interpret mode)
+        last = ki == nk - 1
+        # Causal (cross-length rectangular grid): skip BOTH MXU dots for
+        # k blocks entirely in this q block's future; their k/v copies
+        # are also elided via the clamped index maps in _forward_impl.
+        needed = ((qi + 1) * bq - 1 + (tk - tq) >= ki * bk) if causal \
+            else True
 
     @pl.when(ki == 0)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    # Causal: skip BOTH MXU dots for k blocks that lie entirely in the
-    # future of this q block (they would only add zeros) — for tq == tk
-    # self-attention that is ~half of all grid steps.
-    if causal:
-        needed = (qi + 1) * bq - 1 + (tk - tq) >= ki * bk
-    else:
-        needed = True
 
     @pl.when(needed)
     def _compute():
@@ -104,6 +141,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *refs,
             kpos = (ki * bk
                     + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
             mask = qpos + (tk - tq) >= kpos
+        if with_segments:
+            seg = _seg_mask(qseg_ref, kseg_ref)
+            mask = seg if mask is None else mask & seg
+        if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]                      # [bq, 1]
@@ -123,7 +164,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *refs,
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(last)
     def _finalize():
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -139,8 +180,50 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *refs,
             lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
+def _grid_and_maps(causal, bq, bk, nq, nk, tq, tk, b, h):
+    """(grid, qmap, kvmap, qsegmap, ksegmap) shared by the forward and
+    dQ pallas_calls (identical iteration order). Triangular when
+    eligible — no dead steps at all; else rectangular with the k/v
+    index maps CLAMPED for causal so dead blocks re-reference the
+    previous block and Mosaic elides their copies (same-index
+    revisiting)."""
+    if _use_tri(causal, tq, tk, bq, bk):
+        qi_of = lambda t: _tri_qi_ki(t)[0]
+        ki_of = lambda t: _tri_qi_ki(t)[1]
+        return ((b, h, nq * (nq + 1) // 2),
+                lambda b, h, t: (b, h, qi_of(t), 0),
+                lambda b, h, t: (b, h, ki_of(t), 0),
+                lambda b, h, t: (b, qi_of(t), 0),
+                lambda b, h, t: (b, 0, ki_of(t)))
+    if causal:
+        kmax = lambda i: jnp.clip(((i + 1) * bq - 1 + (tk - tq)) // bk,
+                                  0, nk - 1)
+        j_eff = lambda i, j: jnp.minimum(j, kmax(i))
+    else:
+        j_eff = lambda i, j: j
+    return ((b, h, nq, nk),
+            lambda b, h, i, j: (b, h, i, 0),
+            lambda b, h, i, j: (b, h, j_eff(i, j), 0),
+            lambda b, h, i, j: (b, i, 0),
+            lambda b, h, i, j: (b, 0, j_eff(i, j)))
+
+
+def _seg_operands(segment_ids, b, tq, tk):
+    """(q_seg [B,Tq,128] lane-broadcast, kv_seg [B,8,Tk] sublane-
+    broadcast) int32 — Mosaic-friendly layouts for 1-D per-token ids."""
+    q_seg, kv_seg = segment_ids
+    q_seg = jnp.asarray(q_seg, jnp.int32)
+    kv_seg = jnp.asarray(kv_seg, jnp.int32)
+    if q_seg.shape != (b, tq) or kv_seg.shape != (b, tk):
+        raise ValueError(
+            f"segment_ids shapes {q_seg.shape}/{kv_seg.shape} != "
+            f"({(b, tq)}/{(b, tk)})")
+    return (jnp.broadcast_to(q_seg[:, :, None], (b, tq, 128)),
+            jnp.broadcast_to(kv_seg[:, None, :], (b, 8, tk)))
+
+
 def _forward_impl(q, k, v, causal, scale, block_q, block_k, interpret,
-                  with_lse: bool):
+                  with_lse: bool, segment_ids=None):
     from jax.experimental.pallas import tpu as pltpu
 
     b, tq, h, d = q.shape
@@ -148,26 +231,39 @@ def _forward_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     bq = _divisor_block(tq, block_q)
     bk = _divisor_block(tk, block_k)
     nq, nk = tq // bq, tk // bk
+    tri = _use_tri(causal, tq, tk, bq, bk)
+    with_seg = segment_ids is not None
 
     qt = q.swapaxes(1, 2)                          # [B, H, Tq, D]
     kt = k.swapaxes(1, 2)
     vt = v.swapaxes(1, 2)
     kern = functools.partial(_kernel, scale=scale, causal=causal,
                              bq=bq, bk=bk, nk=nk, tq=tq, tk=tk,
-                             with_lse=with_lse)
-    o_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0))
+                             with_lse=with_lse, tri=tri,
+                             with_segments=with_seg)
+    grid, qmap, kvmap, qsegmap, ksegmap = _grid_and_maps(
+        causal, bq, bk, nq, nk, tq, tk, b, h)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), qmap),
+        pl.BlockSpec((1, 1, bk, d), kvmap),
+        pl.BlockSpec((1, 1, bk, d), kvmap),
+    ]
+    args = [qt, kt, vt]
+    if with_seg:
+        qs, ks = _seg_operands(segment_ids, b, tq, tk)
+        in_specs += [pl.BlockSpec((1, bq, 128), qsegmap),
+                     pl.BlockSpec((1, 8, bk), ksegmap)]
+        args += [qs, ks]
+
+    o_spec = pl.BlockSpec((1, 1, bq, d), qmap)
     o_shape = jax.ShapeDtypeStruct((b, h, tq, d), q.dtype)
-    lse_spec = pl.BlockSpec((1, 1, bq, 128),
-                            lambda b, h, i, j: (b, h, i, 0))
+    lse_spec = pl.BlockSpec((1, 1, bq, 128), qmap)
     lse_shape = jax.ShapeDtypeStruct((b, h, tq, 128), jnp.float32)
     res = pl.pallas_call(
         kern,
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0)),
-        ],
+        grid=grid,
+        in_specs=in_specs,
         out_specs=[o_spec, lse_spec] if with_lse else o_spec,
         out_shape=[o_shape, lse_shape] if with_lse else o_shape,
         scratch_shapes=[
@@ -176,7 +272,7 @@ def _forward_impl(q, k, v, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((bq, d), jnp.float32),      # un-normalized acc
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*args)
     if with_lse:
         out, lse = res
         # out back to BTHD; lse squeezed to [B, H, Tq] (the kernel
@@ -187,7 +283,12 @@ def _forward_impl(q, k, v, causal, scale, block_q, block_k, interpret,
 
 def _pallas_forward_res(q, k, v, causal, scale, block_q, block_k,
                         interpret):
-    """-> (out [B,Tq,H,D], lse [B,H,Tq]) — the training forward."""
+    """-> (out [B,Tq,H,D], lse [B,H,Tq]) — the training forward.
+
+    FIXED ARITY: registered with custom_partitioning, where a trailing
+    default parameter would count as an operand slot — the segmented
+    variants below are separate functions for exactly that reason.
+    """
     return _forward_impl(q, k, v, causal, scale, block_q, block_k,
                          interpret, with_lse=True)
 
@@ -208,22 +309,26 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _recompute_p_ds(q, k, v, do, lse, delta, glse, scale, causal,
-                    qi, ki, bq, bk, tq, tk):
+                    qi, ki, bq, bk, tq, tk, seg=None):
     """Shared block math: p = exp(s - lse) (masked), dp = dO Vᵀ,
     ds = p * (dp - delta + glse) * scale. All f32; lse/delta/glse are
     [bq, 1]. ``glse`` is the cotangent of the lse OUTPUT (d lse/d s is
     exactly p, so it adds inside the parenthesis); zero for plain
     attention, nonzero when attention-state merging consumed the lse
-    (the ring)."""
+    (the ring). ``seg`` is the optional [bq, bk] same-segment mask."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+    mask = None
     if causal:
         qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = qpos + (tk - tq) >= kpos
+    if seg is not None:
+        mask = seg if mask is None else mask & seg
+    if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     p = jnp.exp(s - lse)
-    if causal:
+    if mask is not None:
         p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -232,47 +337,60 @@ def _recompute_p_ds(q, k, v, do, lse, delta, glse, scale, causal,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-               scale, causal, bq, bk, nk, tq, tk, with_glse):
+               scale, causal, bq, bk, nk, tq, tk, with_glse, tri,
+               with_segments):
     # glse is an input only when the lse output's cotangent is nonzero
     # (the ring's state merging); plain attention skips its HBM reads.
     if with_glse:
-        glse_ref, dq_ref, dq_scr = refs
+        glse_ref, *refs = refs
         glse = glse_ref[0, 0, :, :1]
     else:
-        dq_ref, dq_scr = refs
         glse = 0.0
-    qi, ki = pl.program_id(2), pl.program_id(3)
+    if with_segments:
+        qseg_ref, kseg_ref, *refs = refs
+    dq_ref, dq_scr = refs
+    if tri:
+        qi, ki = _tri_qi_ki(pl.program_id(2))
+        last, needed = ki == qi, True
+    else:
+        qi, ki = pl.program_id(2), pl.program_id(3)
+        last = ki == nk - 1
+        needed = ((qi + 1) * bq - 1 + (tk - tq) >= ki * bk) if causal \
+            else True
 
     @pl.when(ki == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    needed = ((qi + 1) * bq - 1 + (tk - tq) >= ki * bk) if causal else True
-
     @pl.when(needed)
     def _compute():
         k = k_ref[0, 0]
+        seg = _seg_mask(qseg_ref, kseg_ref) if with_segments else None
         _, ds = _recompute_p_ds(q_ref[0, 0], k, v_ref[0, 0], do_ref[0, 0],
                                 lse_ref[0, 0, :, :1], delta_ref[0, 0, :, :1],
                                 glse,
-                                scale, causal, qi, ki, bq, bk, tq, tk)
+                                scale, causal, qi, ki, bq, bk, tq, tk,
+                                seg=seg)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(last)
     def _finalize():
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                scale, causal, bq, bk, nq, tq, tk, with_glse):
+                scale, causal, bq, bk, nq, tq, tk, with_glse,
+                with_segments):
     if with_glse:
-        glse_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+        glse_ref, *refs = refs
         glse = glse_ref[0, 0, :, :1]
     else:
-        dk_ref, dv_ref, dk_scr, dv_scr = refs
         glse = 0.0
+    if with_segments:
+        qseg_ref, kseg_ref, *refs = refs
+    dk_ref, dv_ref, dk_scr, dv_scr = refs
     ki, qi = pl.program_id(2), pl.program_id(3)   # note: k outer, q inner
 
     @pl.when(qi == 0)
@@ -286,10 +404,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
     def _compute():
         q = q_ref[0, 0]
         do = do_ref[0, 0]
+        seg = _seg_mask(qseg_ref, kseg_ref) if with_segments else None
         p, ds = _recompute_p_ds(q, k_ref[0, 0], v_ref[0, 0], do,
                                 lse_ref[0, 0, :, :1], delta_ref[0, 0, :, :1],
                                 glse,
-                                scale, causal, qi, ki, bq, bk, tq, tk)
+                                scale, causal, qi, ki, bq, bk, tq, tk,
+                                seg=seg)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -306,10 +426,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 def _pallas_backward(q, k, v, out, lse, do,
                      causal: bool, scale: float,
                      block_q: int, block_k: int, interpret: bool,
-                     glse=None):
+                     glse=None, segment_ids=None):
     """-> (dq, dk, dv), all in their input layouts/dtypes. ``glse``
     [B,H,Tq] is the lse output's cotangent — None (plain attention)
-    compiles kernels without the extra input."""
+    compiles kernels without the extra input. ``segment_ids``:
+    (q_seg [B,Tq], kv_seg [B,Tk]) for packed-sequence masking."""
     from jax.experimental.pallas import tpu as pltpu
 
     b, tq, h, d = q.shape
@@ -318,6 +439,8 @@ def _pallas_backward(q, k, v, out, lse, do,
     bk = _divisor_block(tk, block_k)
     nq, nk = tq // bq, tk // bk
     with_glse = glse is not None
+    with_seg = segment_ids is not None
+    tri = _use_tri(causal, tq, tk, bq, bk)
 
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
     dot_ = do.swapaxes(1, 2)
@@ -328,44 +451,64 @@ def _pallas_backward(q, k, v, out, lse, do,
     lane = lambda x: jnp.broadcast_to(x.astype(jnp.float32)[..., None],
                                       x.shape + (128,))
     rows = [lane(lse), lane(delta)] + ([lane(glse)] if with_glse else [])
+    segs = list(_seg_operands(segment_ids, b, tq, tk)) if with_seg else []
 
-    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0))
-    row_spec = pl.BlockSpec((1, 1, bq, 128), lambda b, h, i, j: (b, h, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0))
-    n_rows = len(rows)
-
+    # dQ: same grid/order as the forward — triangular when eligible,
+    # else rectangular with clamped k/v maps (dead copies elided).
+    grid_dq, qmap, kvmap, qsegmap, ksegmap = _grid_and_maps(
+        causal, bq, bk, nq, nk, tq, tk, b, h)
+    q_spec = pl.BlockSpec((1, 1, bq, d), qmap)
+    row_spec = pl.BlockSpec((1, 1, bq, 128), qmap)
+    kv_spec = pl.BlockSpec((1, 1, bk, d), kvmap)
+    seg_specs = [pl.BlockSpec((1, bq, 128), qsegmap),
+                 pl.BlockSpec((1, 8, bk), ksegmap)] if with_seg else []
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, tq=tq, tk=tk,
-                          with_glse=with_glse),
-        grid=(b, h, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec] + [row_spec] * n_rows,
+                          with_glse=with_glse, tri=tri,
+                          with_segments=with_seg),
+        grid=grid_dq,
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec]
+        + [row_spec] * len(rows) + seg_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot_, *rows)
+    )(qt, kt, vt, dot_, *rows, *segs)
 
-    # Same block roles, transposed grid: k block index is grid axis 2,
-    # q block index is the accumulated axis 3.
-    qi_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0))
+    # dK/dV: same block roles, transposed grid — k block index is grid
+    # axis 2, q block the accumulated axis 3. Dead LEADING q steps of a
+    # causal row clamp their q-side maps to the first needed block, so
+    # their copies are elided (consecutive identical indices).
+    if causal:
+        qmin = lambda j: jnp.clip((j * bk - (tk - tq)) // bq, 0, nq - 1)
+        i_eff = lambda j, i: jnp.maximum(i, qmin(j))
+    else:
+        i_eff = lambda j, i: i
+    qi_spec = pl.BlockSpec((1, 1, bq, d),
+                           lambda b, h, j, i: (b, h, i_eff(j, i), 0))
     rowi_spec = pl.BlockSpec((1, 1, bq, 128),
-                             lambda b, h, j, i: (b, h, i, 0))
+                             lambda b, h, j, i: (b, h, i_eff(j, i), 0))
     kvj_spec = pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0))
+    segi_specs = [pl.BlockSpec((1, bq, 128),
+                               lambda b, h, j, i: (b, i_eff(j, i), 0)),
+                  pl.BlockSpec((1, 8, bk),
+                               lambda b, h, j, i: (b, 0, j))] \
+        if with_seg else []
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, tq=tq, tk=tk,
-                          with_glse=with_glse),
+                          with_glse=with_glse, with_segments=with_seg),
         grid=(b, h, nk, nq),
         in_specs=[qi_spec, kvj_spec, kvj_spec, qi_spec]
-        + [rowi_spec] * n_rows,
+        + [rowi_spec] * len(rows) + segi_specs,
         out_specs=[kvj_spec, kvj_spec],
         out_shape=[jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot_, *rows)
+    )(qt, kt, vt, dot_, *rows, *segs)
     return (dq.swapaxes(1, 2), dk.swapaxes(1, 2), dv.swapaxes(1, 2))
 
 
@@ -516,17 +659,159 @@ _flash_local = _make_flash(_pallas_forward, _pallas_forward_res,
                            _pallas_backward)
 
 
+# ---------------------------------------------------------------------------
+# Segmented (packed-sequence) variants: separate FIXED-ARITY primitives
+# — segment ids are real operands, and both custom_partitioning and
+# custom_vjp count every non-static parameter as an operand slot, so
+# the plain primitives cannot grow an optional argument.
+# ---------------------------------------------------------------------------
+
+
+def _pallas_forward_seg(q, k, v, qseg, kseg, causal, scale, block_q,
+                        block_k, interpret):
+    return _forward_impl(q, k, v, causal, scale, block_q, block_k,
+                         interpret, with_lse=False,
+                         segment_ids=(qseg, kseg))
+
+
+def _pallas_forward_res_seg(q, k, v, qseg, kseg, causal, scale, block_q,
+                            block_k, interpret):
+    return _forward_impl(q, k, v, causal, scale, block_q, block_k,
+                         interpret, with_lse=True,
+                         segment_ids=(qseg, kseg))
+
+
+def _pallas_backward_seg(q, k, v, qseg, kseg, out, lse, do, causal,
+                         scale, block_q, block_k, interpret):
+    return _pallas_backward(q, k, v, out, lse, do, causal, scale,
+                            block_q, block_k, interpret,
+                            segment_ids=(qseg, kseg))
+
+
+def _seg_sharding(mesh, spec):
+    """1-D-per-token operands shard over batch only."""
+    return NamedSharding(mesh, P(spec[0], None))
+
+
+def _partition_fwd_seg(causal, scale, block_q, block_k, interpret, mesh,
+                       arg_shapes, result_shape):
+    s4, _ = _shardings(mesh, _q_spec_of(arg_shapes))
+    sseg = _seg_sharding(mesh, _q_spec_of(arg_shapes))
+
+    def lower_fn(q, k, v, qseg, kseg):
+        return _pallas_forward_seg(q, k, v, qseg, kseg, causal, scale,
+                                   block_q, block_k, interpret)
+
+    return mesh, lower_fn, s4, (s4, s4, s4, sseg, sseg)
+
+
+def _partition_res_seg(causal, scale, block_q, block_k, interpret, mesh,
+                       arg_shapes, result_shape):
+    s4, s3 = _shardings(mesh, _q_spec_of(arg_shapes))
+    sseg = _seg_sharding(mesh, _q_spec_of(arg_shapes))
+
+    def lower_fn(q, k, v, qseg, kseg):
+        return _pallas_forward_res_seg(q, k, v, qseg, kseg, causal,
+                                       scale, block_q, block_k, interpret)
+
+    return mesh, lower_fn, (s4, s3), (s4, s4, s4, sseg, sseg)
+
+
+def _partition_bwd_seg(causal, scale, block_q, block_k, interpret, mesh,
+                       arg_shapes, result_shape):
+    s4, s3 = _shardings(mesh, _q_spec_of(arg_shapes))
+    sseg = _seg_sharding(mesh, _q_spec_of(arg_shapes))
+
+    def lower_fn(q, k, v, qseg, kseg, out, lse, do):
+        return _pallas_backward_seg(q, k, v, qseg, kseg, out, lse, do,
+                                    causal, scale, block_q, block_k,
+                                    interpret)
+
+    return (mesh, lower_fn, (s4, s4, s4),
+            (s4, s4, s4, sseg, sseg, s4, s3, s4))
+
+
+_SEG_STATIC = dict(static_argnums=(5, 6, 7, 8, 9))
+
+_partitioned_seg = custom_partitioning(_pallas_forward_seg, **_SEG_STATIC)
+_partitioned_seg.def_partition(
+    partition=_partition_fwd_seg,
+    infer_sharding_from_operands=_infer_fwd,
+    sharding_rule=("b tq h d, b tk h d, b tk h d, b tq, b tk "
+                   "-> b tq h d"),
+    need_replication_factors=_REPL,
+)
+
+_partitioned_res_seg = custom_partitioning(_pallas_forward_res_seg,
+                                           **_SEG_STATIC)
+_partitioned_res_seg.def_partition(
+    partition=_partition_res_seg,
+    infer_sharding_from_operands=_infer_res,
+    sharding_rule=("b tq h d, b tk h d, b tk h d, b tq, b tk "
+                   "-> b tq h d, b h tq"),
+    need_replication_factors=_REPL,
+)
+
+_partitioned_bwd_seg = custom_partitioning(
+    _pallas_backward_seg, static_argnums=(8, 9, 10, 11, 12))
+_partitioned_bwd_seg.def_partition(
+    partition=_partition_bwd_seg,
+    infer_sharding_from_operands=_infer_bwd,
+    sharding_rule=("b tq h d, b tk h d, b tk h d, b tq, b tk, "
+                   "b tq h d, b h tq, b tq h d "
+                   "-> b tq h d, b tk h d, b tk h d"),
+    need_replication_factors=_REPL,
+)
+
+
+def _make_flash_seg(fwd_prim, res_prim, bwd_prim):
+    """custom_vjp wiring for the segmented variants; segment ids are
+    integer operands whose cotangents are symbolic-zero float0."""
+    import numpy as np
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+    def f(q, k, v, qseg, kseg, causal, scale, block_q, block_k,
+          interpret):
+        return fwd_prim(q, k, v, qseg, kseg, causal, scale, block_q,
+                        block_k, interpret)
+
+    def fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k,
+            interpret):
+        out, lse = res_prim(q, k, v, qseg, kseg, causal, scale, block_q,
+                            block_k, interpret)
+        return out, (q, k, v, qseg, kseg, out, lse)
+
+    def bwd(causal, scale, block_q, block_k, interpret, res, g):
+        q, k, v, qseg, kseg, out, lse = res
+        dq, dk, dv = bwd_prim(q, k, v, qseg, kseg, out, lse, g, causal,
+                              scale, block_q, block_k, interpret)
+        z = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+        return dq, dk, dv, z(qseg), z(kseg)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+_flash_seg = _make_flash_seg(_partitioned_seg, _partitioned_res_seg,
+                             _partitioned_bwd_seg)
+_flash_seg_local = _make_flash_seg(_pallas_forward_seg,
+                                   _pallas_forward_res_seg,
+                                   _pallas_backward_seg)
+
+
 def local_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                           causal: bool = False,
                           scale: Optional[float] = None,
                           block_q: int = 512,
                           block_k: int = 512,
-                          interpret: Optional[bool] = None) -> jax.Array:
+                          interpret: Optional[bool] = None,
+                          segment_ids=None) -> jax.Array:
     """flash_attention for use INSIDE shard_map bodies: per-shard
     arrays, no custom_partitioning wrapper. Same fallbacks (dense for
-    degenerate lengths; dense off-TPU unless interpret=True)."""
-    return _entry(_flash_local, q, k, v, causal, scale, block_q, block_k,
-                  interpret)
+    degenerate lengths; dense off-TPU unless interpret=True) and the
+    same optional packed-sequence ``segment_ids``."""
+    return _entry(_flash_local, _flash_seg_local, q, k, v, causal, scale,
+                  block_q, block_k, interpret, segment_ids=segment_ids)
 
 
 # Attention-STATE variant for the ring: returns (out, lse) so partial
@@ -598,13 +883,18 @@ def merge_attention_states(state_a, state_b):
     return out.astype(oa.dtype), lse
 
 
-def _entry(prim, q, k, v, causal, scale, block_q, block_k, interpret):
+def _entry(prim, seg_prim, q, k, v, causal, scale, block_q, block_k,
+           interpret, segment_ids=None):
     """Shared entry prologue for both public wrappers: scale default,
-    degenerate-length dense fallback, off-TPU/interpret resolution."""
+    degenerate-length dense fallback, off-TPU/interpret resolution,
+    and routing to the fixed-arity segmented primitive when packed-
+    sequence segment ids are given."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     tq, tk = q.shape[1], k.shape[1]
     bq = _divisor_block(tq, block_q)
     bk = _divisor_block(tk, block_k)
+    dense = functools.partial(dense_attention, q, k, v, causal=causal,
+                              scale=scale, segment_ids=segment_ids)
     if (bq < 64 and bq < min(block_q, tq)) or \
             (bk < 64 and bk < min(block_k, tk)):
         # Degenerate lengths (primes etc.) whose only divisors are tiny:
@@ -612,7 +902,7 @@ def _entry(prim, q, k, v, causal, scale, block_q, block_k, interpret):
         # fall back to one dense pass instead, the same policy as
         # attention.py's _auto_block. (An explicitly requested small
         # block is honored: tests drive the kernel with block 16/32.)
-        return dense_attention(q, k, v, causal=causal, scale=scale)
+        return dense()
     if interpret is None:
         if os.environ.get("TPUNET_FLASH_INTERPRET",
                           "").lower() not in ("", "0", "false"):
@@ -620,9 +910,14 @@ def _entry(prim, q, k, v, causal, scale, block_q, block_k, interpret):
             # exercises the real kernel body, not the dense fallback).
             interpret = True
         elif jax.default_backend() != "tpu":
-            return dense_attention(q, k, v, causal=causal, scale=scale)
+            return dense()
         else:
             interpret = False
+    if segment_ids is not None:
+        qseg = jnp.asarray(segment_ids[0], jnp.int32)
+        kseg = jnp.asarray(segment_ids[1], jnp.int32)
+        return seg_prim(q, k, v, qseg, kseg, causal, scale, block_q,
+                        block_k, interpret)
     return prim(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
@@ -631,14 +926,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     scale: Optional[float] = None,
                     block_q: int = 512,
                     block_k: int = 512,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    segment_ids=None) -> jax.Array:
     """Fused flash attention, BTHD layout, drop-in for dense_attention.
 
     On TPU the Pallas kernel runs; off-TPU the default is the XLA dense
     reference (pass ``interpret=True`` to exercise the kernel in tests).
     Blocks clamp to the largest divisor of the sequence length <= the
     requested size, so any length works (degenerate lengths fall back
-    to a dense pass).
+    to a dense pass). ``segment_ids``: optional (q_seg [B,Tq],
+    kv_seg [B,Tk]) int pair for packed-sequence masking — a query
+    attends only to keys with the same segment id (compose with
+    ``causal`` for packed causal LM training; padding gets a dedicated
+    id so real tokens never attend to it).
     """
-    return _entry(_flash, q, k, v, causal, scale, block_q, block_k,
-                  interpret)
+    return _entry(_flash, _flash_seg, q, k, v, causal, scale, block_q,
+                  block_k, interpret, segment_ids=segment_ids)
