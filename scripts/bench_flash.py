@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Flash-kernel microbenchmark: fwd and fwd+bwd per attention impl.
+
+Reproduces (and extends) the round-1 kernel measurement — forward at
+B=4, T=4096, H=8, D=64, causal, bfloat16 on one chip — now that the
+causal grid is triangular (forward/dQ) with dead copies elided
+elsewhere. Round-1 recorded numbers for the same shape (rectangular
+grid + @pl.when skip): flash 10.7 ms fwd vs dense 25.6 ms vs blockwise
+17.1 ms (tpunet/ops/flash.py module docstring).
+
+Prints one JSON line per (impl, mode). Synchronization fetches a value
+data-dependent on the result (this backend's block_until_ready can
+return early on small outputs — BASELINE sync pitfall).
+
+    python scripts/bench_flash.py [--t 4096] [--steps 20] [--seg]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def sync(x):
+    return float(np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0])
+
+
+def bench(fn, args, steps, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / steps * 1e3  # ms
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--b", type=int, default=4)
+    p.add_argument("--t", type=int, default=4096)
+    p.add_argument("--h", type=int, default=8)
+    p.add_argument("--d", type=int, default=64)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--block", type=int, default=512)
+    p.add_argument("--seg", action="store_true",
+                   help="also bench the segmented (packed) variant")
+    args = p.parse_args()
+
+    from tpunet.ops.attention import blockwise_attention, dense_attention
+    from tpunet.ops.flash import flash_attention
+
+    rng = np.random.default_rng(0)
+    shp = (args.b, args.t, args.h, args.d)
+    q = jnp.asarray(rng.standard_normal(shp), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal(shp), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal(shp), jnp.bfloat16)
+    # 4 packed docs per row for the segmented bench
+    seg = jnp.asarray(np.repeat(np.arange(1, 5, dtype=np.int32),
+                                args.t // 4)[None].repeat(args.b, 0))
+
+    impls = {
+        "flash": lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=args.block, block_k=args.block),
+        "dense": lambda q, k, v: dense_attention(q, k, v, causal=True),
+        "blockwise": lambda q, k, v: blockwise_attention(
+            q, k, v, causal=True, block_size=args.block),
+    }
+    if args.seg:
+        impls["flash+seg"] = lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=args.block, block_k=args.block,
+            segment_ids=(seg, seg))
+
+    meta = {"b": args.b, "t": args.t, "h": args.h, "d": args.d,
+            "dtype": "bfloat16", "causal": True,
+            "platform": jax.devices()[0].platform,
+            "device_kind": jax.devices()[0].device_kind}
+    for name, f in impls.items():
+        fwd = jax.jit(f)
+        ms_f = bench(fwd, (q, k, v), args.steps)
+        loss = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        ms_b = bench(loss, (q, k, v), args.steps)
+        print(json.dumps({"impl": name, "fwd_ms": round(ms_f, 3),
+                          "fwd_bwd_ms": round(ms_b, 3), **meta}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
