@@ -69,9 +69,12 @@ def main():
     q = jnp.asarray(rng.standard_normal(shp), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal(shp), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal(shp), jnp.bfloat16)
-    # 4 packed docs per row for the segmented bench
-    seg = jnp.asarray(np.repeat(np.arange(1, 5, dtype=np.int32),
-                                args.t // 4)[None].repeat(args.b, 0))
+    # 4 packed docs per row for the segmented bench (last doc absorbs
+    # the t % 4 remainder)
+    seg_row = np.concatenate([
+        np.full(args.t // 4, i + 1, np.int32) for i in range(3)
+    ] + [np.full(args.t - 3 * (args.t // 4), 4, np.int32)])
+    seg = jnp.asarray(seg_row[None].repeat(args.b, 0))
 
     impls = {
         "flash": lambda q, k, v: flash_attention(

@@ -164,6 +164,29 @@ def test_grad_accum_pipeline_indivisible_raises():
                      pp_microbatches=8))
 
 
+@pytest.mark.slow
+def test_lmpp_checkpoint_serves_through_generate_cli(tmp_path, capsys):
+    """Train pipelined, serve incrementally: an lm_pp best checkpoint
+    loads through the generate CLI (--model lm_pp), unstacked into the
+    KV-cache TransformerLM."""
+    cfg = _cfg(MeshConfig(data=2, pipe=2)).replace(
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "ck"),
+                                    save_last=False))
+    tr = Trainer(cfg)
+    try:
+        tr.train()
+    finally:
+        tr.close()
+    from tpunet.infer import generate as gen
+    gen.main(["--checkpoint-dir", str(tmp_path / "ck"), "--model",
+              "lm_pp", "--prompt", "5 7 3", "--tokens", "5",
+              "--vit-hidden", "64", "--vit-depth", "4", "--vit-heads",
+              "4", "--vocab-size", "32", "--max-seq-len", "32"])
+    out = capsys.readouterr().out.strip().splitlines()[-1].split()
+    assert out[:3] == ["5", "7", "3"] and len(out) == 8
+    assert all(0 <= int(t) < 32 for t in out)
+
+
 def test_lmpp_rejects_unsupported_features():
     with pytest.raises(ValueError, match="dense"):
         create_model(dataclasses.replace(LMPP_CFG, attention="ring"))

@@ -32,15 +32,22 @@ def load_lm(model_cfg: ModelConfig,
             variables: Optional[dict] = None) -> Tuple[object, dict]:
     """Build the LM and load its best-checkpoint params (serving is
     single-chip: sequence-parallel attention configs swap to dense,
-    same function — mirrors infer.Predictor)."""
-    if model_cfg.name != "lm":
-        raise ValueError(f"generation needs the 'lm' model, got "
-                         f"{model_cfg.name!r}")
+    same function — mirrors infer.Predictor). Pipeline-trained
+    checkpoints (name 'lm_pp') restore in their stacked layout and are
+    unstacked into the TransformerLM tree, which owns the KV-cache
+    decode path — train pipelined, serve incrementally."""
+    if model_cfg.name not in ("lm", "lm_pp"):
+        raise ValueError(f"generation needs the 'lm' (or 'lm_pp') "
+                         f"model, got {model_cfg.name!r}")
     if model_cfg.attention in ("ring", "ulysses"):
         model_cfg = dataclasses.replace(model_cfg, attention="dense")
+    is_pp = model_cfg.name == "lm_pp"
+    restore_cfg = model_cfg
+    model_cfg = dataclasses.replace(model_cfg, name="lm")
     model = create_model(model_cfg)
     if variables is None:
-        variables = init_variables(model, jax.random.PRNGKey(0),
+        restore_model = (create_model(restore_cfg) if is_pp else model)
+        variables = init_variables(restore_model, jax.random.PRNGKey(0),
                                    seq_len=min(16, model_cfg.max_seq_len))
         if checkpoint_dir:
             ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
@@ -50,6 +57,12 @@ def load_lm(model_cfg: ModelConfig,
                 raise FileNotFoundError(
                     f"no best checkpoint under {checkpoint_dir!r}")
             variables = {"params": best["params"]}
+    if is_pp and "blocks_qkv_k" in variables["params"]:
+        # Stacked pipeline layout (restored above, or passed in directly
+        # by an in-process caller): unstack into the TransformerLM tree.
+        from tpunet.models.lm_pp import to_transformer_lm_params
+        variables = {"params":
+                     to_transformer_lm_params(variables["params"])}
     return model, {"params": variables["params"]}
 
 
@@ -86,6 +99,9 @@ def main(argv=None):
                         "probability mass to sample from (0 = off)")
     p.add_argument("--seed", type=int, default=0)
     # Architecture of the trained checkpoint (must match training).
+    p.add_argument("--model", choices=("lm", "lm_pp"), default="lm",
+                   help="lm_pp: a pipeline-trained checkpoint, unstacked "
+                        "into the incremental-decode model at load")
     p.add_argument("--vit-hidden", type=int, default=192)
     p.add_argument("--vit-depth", type=int, default=6)
     p.add_argument("--vit-heads", type=int, default=3)
@@ -112,7 +128,7 @@ def main(argv=None):
                          "--temperature > 0 (temperature 0 is greedy "
                          "decoding and would silently ignore them)")
 
-    cfg = ModelConfig(name="lm", vit_hidden=args.vit_hidden,
+    cfg = ModelConfig(name=args.model, vit_hidden=args.vit_hidden,
                       vit_depth=args.vit_depth, vit_heads=args.vit_heads,
                       vocab_size=args.vocab_size,
                       max_seq_len=args.max_seq_len, dropout_rate=0.0)
