@@ -20,7 +20,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -35,18 +39,26 @@ if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
 
 
 def sync(x):
-    return float(np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0])
+    # Fetch ONE element data-dependent on the result: a full-array
+    # np.asarray would ship the whole tensor through the (slow) tunnel
+    # and dominate the measurement; block_until_ready alone can return
+    # early on this backend (BASELINE sync pitfall).
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(np.asarray(leaf.ravel()[0]))
 
 
-def bench(fn, args, steps, warmup=3):
+def bench(fn, args, steps, warmup=3, reps=3):
     for _ in range(warmup):
         out = fn(*args)
     sync(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    sync(out)
-    return (time.perf_counter() - t0) / steps * 1e3  # ms
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best / steps * 1e3  # ms
 
 
 def main():

@@ -30,19 +30,22 @@ Design notes:
   kernel body on CPU with interpret=True, the same scheme as
   tpunet/ops/depthwise.py.
 
-Measured on a real TPU v5e chip, forward (B=4, T=4096, H=8, D=64,
-causal, bfloat16; synchronized by fetching a data-dependent output
-element): flash 10.7 ms/call vs dense 25.6 ms vs blockwise 17.1 ms —
-2.4x over XLA's dense emitter (forward-only calls skip the lse
-residual writes). Of that, the causal block-skip
-(@pl.when around both dots for fully-future k blocks) is worth ~8%
-(skipped blocks still pay their grid step and k/v block copies —
-restricting the grid itself is the next step) and keeping the dots in
-bf16 another ~4%. End-to-end LM training (fwd + bwd + Adam, the
-numbers that matter): 339k tok/s at T=2048 vs 161k dense, and 135k
-tok/s at T=8192+remat vs 28k blockwise — the flash backward kernels
-remove the O(T²) HBM traffic that binds the dense backward
-(scripts/bench_lm.py; full table in README.md).
+Measured on a real TPU v5e chip (B=4, T=4096, H=8, D=64, causal,
+bfloat16; synchronized by fetching a data-dependent output element;
+scripts/bench_flash.py):
+
+  round 1 (rectangular causal grid + @pl.when skip):
+    fwd: flash 10.7 ms vs dense 25.6 ms vs blockwise 17.1 ms
+  round 2 (fused TRIANGULAR causal grid, dead copies elided):
+    fwd: flash 8.6 ms (-20% vs round 1; 2.45x dense's 21.1 ms)
+    fwd+bwd: flash 13.0 ms vs dense 39.7 ms (3.1x) vs blockwise 50.7 ms
+    segments (4 packed docs): 8.0 ms fwd — masking costs ~nothing
+
+End-to-end LM training (fwd + bwd + Adam, the numbers that matter):
+357k tok/s at T=2048 vs 157k dense (2.3x; was 339k with the
+rectangular grid), and 135k tok/s at T=8192+remat vs 28k blockwise —
+the flash backward kernels remove the O(T²) HBM traffic that binds the
+dense backward (scripts/bench_lm.py; full table in README.md).
 """
 
 from __future__ import annotations
