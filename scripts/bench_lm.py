@@ -47,6 +47,11 @@ def main() -> None:
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--attention", nargs="+",
                    default=["dense", "blockwise", "flash"])
+    p.add_argument("--model", choices=("lm", "lm_pp"), default="lm",
+                   help="lm_pp benches the PIPELINED formulation "
+                        "(stacked-scan blocks; dense attention only) — "
+                        "on one chip this measures the pipe=1 overhead "
+                        "of the formulation itself")
     p.add_argument("--steps", type=int, default=12)
     p.add_argument("--reps", type=int, default=2)
     p.add_argument("--remat", action="store_true",
@@ -70,13 +75,17 @@ def main() -> None:
               "skipping it", file=sys.stderr, flush=True)
         args.attention = [a for a in args.attention if a != "flash"]
 
+    if args.model == "lm_pp":
+        args.attention = ["dense"]     # the pipelined blocks' only core
+
     results = {}
     for attn in args.attention:
         mcfg = ModelConfig(
-            name="lm", vit_hidden=args.hidden, vit_depth=args.depth,
+            name=args.model, vit_hidden=args.hidden,
+            vit_depth=args.depth,
             vit_heads=args.heads, vocab_size=args.vocab,
             max_seq_len=args.seq_len, dropout_rate=0.0, attention=attn,
-            remat=args.remat)
+            remat=args.remat and args.model == "lm")
         model = create_model(mcfg)
         variables = init_variables(model, jax.random.PRNGKey(0),
                                    seq_len=args.seq_len)
@@ -110,7 +119,8 @@ def main() -> None:
 
     print(json.dumps({
         "metric": "lm_train_tokens_per_sec",
-        "config": {"batch": args.batch, "seq_len": args.seq_len,
+        "config": {"model": args.model, "batch": args.batch,
+                   "seq_len": args.seq_len,
                    "hidden": args.hidden, "depth": args.depth,
                    "heads": args.heads, "remat": args.remat,
                    "platform": jax.devices()[0].platform},

@@ -21,11 +21,30 @@ composes too — the accumulation scan in steps.py wraps the whole
 pipelined program (microbatching in TIME over microbatching in STAGES).
 
 With pipe == 1 the stacked params run as a plain lax.scan over layers —
-the same math, which the parity tests assert. No KV-cache decode path:
-generation/serving loads lm_pp checkpoints into the (architecturally
-identical) TransformerLM via tpunet/models/registry conversion, or
-simply evaluates full-prefix; the reference has no LM serving at all
-(SURVEY.md section 0 — this whole family is beyond parity).
+the same math, which the parity tests assert. No KV-cache decode path
+in this module: generation/serving unstacks lm_pp checkpoints into the
+(architecturally identical) TransformerLM via to_transformer_lm_params
+(tpunet/infer/generate.py --model lm_pp); the reference has no LM
+serving at all (SURVEY.md section 0 — this whole family is beyond
+parity).
+
+Measured cost of the formulation (v5e chip, scripts/bench_lm.py
+--model lm_pp, T=2048 B=8 depth=4 hidden=512): 132k tok/s at pipe=1 vs
+157k for the unrolled dense TransformerLM — scan-over-layers gives up
+~16% of XLA's inter-layer fusion; that overhead is the price of being
+shardable over 'pipe', which pays only at real multi-stage meshes
+(unmeasurable on this 1-chip environment; the dp x pp dryrun leg
+validates the program, not its scaling).
+
+Schedule note: the executor is plain GPipe (bubble (S-1)/(M+S-1)).
+A hand-scheduled 1F1B would need manual VJP orchestration — JAX's
+reverse-mode AD through the scan+ppermute already EMITS the standard
+backward pipeline, but its schedule (all forwards, then all backwards)
+is fixed by AD; interleaving fwd/bwd per microbatch means writing the
+backward by hand. Deliberately not done: the memory win 1F1B buys is
+covered more cheaply here by per-stage activation bounding (the scan
+carries one microbatch's activations per stage) and --remat on the
+other families.
 """
 
 from __future__ import annotations
