@@ -12,20 +12,32 @@ Design: the host ships raw 32x32 uint8 batches (3 KB/image instead of the
 ~588 KB/image a host-side 224px float pipeline would transfer), and the
 whole augmentation runs inside the jitted train step:
 
-  hflip -> rotate(+-15 deg, bilinear, at 32x32 where the gather is tiny)
-  -> fused random-resized-crop + resize-to-224 expressed as two separable
-  per-image bilinear matrices (a (224,32) row matrix and column matrix),
-  i.e. batched matmuls that map straight onto the MXU -> color jitter
-  (elementwise) -> normalize.
+  hflip -> rotate(+-15 deg, bilinear, edge fill, at 32x32 where the
+  gather is tiny) -> fused random-resized-crop + resize-to-224 expressed
+  as two separable per-image bilinear matrices (a (224,32) row matrix
+  and column matrix), i.e. batched matmuls that map straight onto the
+  MXU -> color jitter (elementwise) -> torchvision's rotate-last black
+  BORDER geometry as a closed-form coverage mask at 224 (elementwise;
+  no output-resolution gather) -> normalize.
 
 Documented deviations from torchvision semantics (distribution-level
-equivalent, pixel-level different): rotation happens before the crop
-rather than after (so the rotation gather runs at 32x32, not 224x224);
-ColorJitter sub-ops apply in fixed order (brightness, contrast,
-saturation, hue) rather than a random permutation; RandomResizedCrop
-clamps the sampled box instead of torchvision's 10-attempt rejection
-loop. Crop-box sampling, jitter strengths, rotation range, and
-normalization stats match the reference exactly.
+equivalent — quantified in tests/test_augment_stats.py against a PIL
+reference): ColorJitter sub-ops apply in fixed order (brightness,
+contrast, saturation, hue) rather than a random permutation;
+RandomResizedCrop clamps the sampled box instead of torchvision's
+10-attempt rejection loop; hflip runs first (commutes with the crop
+distribution); CONTENT rotation still happens before the crop, at the
+32px source (so it composes with the crop's anisotropic scaling as a
+slight shear vs torchvision's post-resize rotation, and edge-fill can
+smear frame borders into view) — but the rotation BORDER geometry is
+torchvision's exactly: the black corners a rotate-last pipeline leaves
+on the full output frame are applied as a closed-form coverage mask at
+output resolution (round 1's zero-fill rotate-before-crop shed most of
+that border mass — 0.5% dark-pixel mass vs ~2.5%, +0.03 channel-mean
+shift, measured in test_augment_stats; a literal rotate-at-224 gather
+measured ~11x slower end-to-end on the v5e). Crop-box sampling, jitter
+strengths, rotation range, and normalization stats match the reference
+exactly.
 """
 
 from __future__ import annotations
@@ -81,27 +93,66 @@ def _apply_separable(img, row_m, col_m):
 # Rotation (gather at source resolution)
 # ---------------------------------------------------------------------------
 
-def _rotate_bilinear(img, angle):
-    """Rotate (H, W, C) float image by ``angle`` radians, zero fill."""
-    h, w = img.shape[0], img.shape[1]
+def _inverse_rot_coords(h: int, w: int, angle):
+    """(sy, sx) source coordinates of each output pixel under the
+    inverse rotation about the image center — the ONE copy of the
+    center convention and rotation direction, shared by the content
+    gather and the border mask so they can never misalign."""
     cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
     yy, xx = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
                           jnp.arange(w, dtype=jnp.float32), indexing="ij")
     cos, sin = jnp.cos(angle), jnp.sin(angle)
     sy = cos * (yy - cy) + sin * (xx - cx) + cy
     sx = -sin * (yy - cy) + cos * (xx - cx) + cx
+    return sy, sx
+
+
+def _rotate_bilinear(img, angle, fill: str = "zero"):
+    """Rotate (H, W, C) float image by ``angle`` radians.
+
+    ``fill="zero"`` zeroes out-of-frame taps (PIL semantics);
+    ``fill="edge"`` clamps to the border pixel — used by the train
+    pipeline, whose torchvision-matching black borders are applied
+    separately by the ANALYTIC mask below (no gather at the output
+    resolution, where a per-pixel gather measured an ~11x train-step
+    slowdown on the v5e)."""
+    h, w = img.shape[0], img.shape[1]
+    sy, sx = _inverse_rot_coords(h, w, angle)
     y0, x0 = jnp.floor(sy), jnp.floor(sx)
     wy, wx = (sy - y0)[..., None], (sx - x0)[..., None]
+    zero_fill = fill == "zero"
 
     def gather(yi, xi):
-        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
         yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
         xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
-        return img[yc, xc] * valid[..., None]
+        out = img[yc, xc]
+        if zero_fill:
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            out = out * valid[..., None]
+        return out
 
     top = gather(y0, x0) * (1 - wx) + gather(y0, x0 + 1) * wx
     bot = gather(y0 + 1, x0) * (1 - wx) + gather(y0 + 1, x0 + 1) * wx
     return top * (1 - wy) + bot * wy
+
+
+def _rotation_border_mask(size: int, angle):
+    """The bilinear COVERAGE of a ``size``-square frame rotated by
+    ``angle`` — i.e. exactly the alpha PIL's rotate gives a ones-image
+    (soft 1px edge included) — computed in closed form per pixel:
+    separable validity-weighted tap fractions of the inverse-rotated
+    coordinates. Pure elementwise math, so applying torchvision's
+    post-rotation black corners costs nothing on TPU."""
+    sy, sx = _inverse_rot_coords(size, size, angle)
+
+    def cov(s):
+        i0 = jnp.floor(s)
+        f = s - i0
+        v0 = ((i0 >= 0) & (i0 <= size - 1)).astype(jnp.float32)
+        v1 = ((i0 + 1 >= 0) & (i0 + 1 <= size - 1)).astype(jnp.float32)
+        return (1.0 - f) * v0 + f * v1
+
+    return cov(sy) * cov(sx)
 
 
 # ---------------------------------------------------------------------------
@@ -200,14 +251,23 @@ def _augment_one(key, img_u8, cfg: DataConfig):
     x = jnp.where(flip, x[:, ::-1, :], x)
     if cfg.rotation_degrees > 0:
         angle = jax.random.uniform(
-            kr, (), minval=-cfg.rotation_degrees, maxval=cfg.rotation_degrees
-        ) * (math.pi / 180.0)
-        x = _rotate_bilinear(x, angle)
+            kr, (), minval=-cfg.rotation_degrees,
+            maxval=cfg.rotation_degrees) * (math.pi / 180.0)
+        # Content rotation at the 32px SOURCE (tiny gather), edge fill.
+        x = _rotate_bilinear(x, angle, fill="edge")
     top, left, h, w = _rrc_params(kc, cfg)
     row_m = _bilinear_matrix(top, h, cfg.image_size, SRC)
     col_m = _bilinear_matrix(left, w, cfg.image_size, SRC)
     x = _apply_separable(x, row_m, col_m)
     x = _color_jitter(kj, x, cfg)
+    if cfg.rotation_degrees > 0:
+        # torchvision rotates LAST, leaving black corners on the full
+        # output frame — reproduced here as the closed-form coverage
+        # mask at 224 (a round-1-style zero-fill rotate-before-crop
+        # shed most of that border mass: measured 0.5% dark pixels vs
+        # torchvision's ~2.5% and a +0.03 channel-mean shift; a literal
+        # rotate-at-224 gather measured ~11x slower end-to-end).
+        x = x * _rotation_border_mask(cfg.image_size, angle)[..., None]
     mean = jnp.asarray(cfg.mean)
     std = jnp.asarray(cfg.std)
     return (x - mean) / std
