@@ -84,6 +84,10 @@ def run_mode(mode: str, env: dict, out_dir: str, common: list[str],
             f"[{mode}] run was preempted mid-epoch (partial row at epoch "
             f"{partial[-1]['epoch']}); rerun to get a complete comparison")
     total = sum(r["seconds"] for r in rows)
+    # Steady state = the fastest epoch: short runs put the (possibly
+    # minutes-long on a cold cache) XLA compile inside epoch 1, which
+    # the reference's 20-epoch totals amortize away but a 2-epoch
+    # artifact does not.
     return {
         "mode": mode,
         "global_batch": batch,
@@ -92,6 +96,8 @@ def run_mode(mode: str, env: dict, out_dir: str, common: list[str],
         "wall_seconds": round(wall, 2),  # includes compile/startup
         "images_per_sec": round(sum(r["examples_per_sec"] * r["seconds"]
                                     for r in rows) / total, 2),
+        "steady_epoch_seconds": round(min(r["seconds"] for r in rows), 2),
+        "steady_images_per_sec": max(r["examples_per_sec"] for r in rows),
         "best_test_accuracy": max(r["test_accuracy"] for r in rows),
         "final_train_loss": rows[-1]["train_loss"],
     }
@@ -164,9 +170,12 @@ def main(argv=None) -> int:
                             128 * n_dist, "distributed.log"))
 
     serial_t = results[0]["total_seconds"]
+    serial_s = results[0]["steady_epoch_seconds"]
     for r in results:
         r["hardware"] = hw[r["mode"]]
         r["speedup_vs_serial"] = round(serial_t / r["total_seconds"], 2)
+        r["steady_speedup_vs_serial"] = round(
+            serial_s / r["steady_epoch_seconds"], 2)
 
     meta = {
         "dataset": "cifar10" if have_real else "synthetic",
@@ -196,20 +205,24 @@ def main(argv=None) -> int:
         "CIFAR-10, 20 epochs, 224px).",
         "",
         "| Training Mode | Hardware | Global batch | Total time (s) "
-        "| img/s | Best test acc | Speedup vs serial |",
-        "|---|---|---|---|---|---|---|",
+        "| Steady epoch (s) | Steady img/s | Best test acc "
+        "| Steady speedup vs serial |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for r in results:
         lines.append(
             f"| {r['mode']} | {r['hardware']} | {r['global_batch']} "
-            f"| {r['total_seconds']} | {r['images_per_sec']} "
+            f"| {r['total_seconds']} | {r['steady_epoch_seconds']} "
+            f"| {r['steady_images_per_sec']} "
             f"| {r['best_test_accuracy']:.4f} "
-            f"| {r['speedup_vs_serial']:.2f}x |")
+            f"| {r['steady_speedup_vs_serial']:.2f}x |")
     lines += ["",
               "Total time sums per-epoch seconds (train + eval, as the "
-              "reference logs do); img/s is the train-pass throughput "
-              "from metrics.jsonl; accuracy is globally reduced (the "
-              "reference's distributed number was rank-local).", ""]
+              "reference logs do); the steady columns use the fastest "
+              "epoch, excluding the XLA compile a short run cannot "
+              "amortize (the reference's 20-epoch totals do); accuracy "
+              "is globally reduced (the reference's distributed number "
+              "was rank-local).", ""]
     with open(os.path.join(out_dir, "COMPARE.md"), "w") as f:
         f.write("\n".join(lines))
     print("\n".join(lines))
