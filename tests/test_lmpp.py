@@ -187,6 +187,22 @@ def test_lmpp_checkpoint_serves_through_generate_cli(tmp_path, capsys):
     assert all(0 <= int(t) < 32 for t in out)
 
 
+def test_attention_auto_resolves_by_backend():
+    """attention='auto' picks flash on TPU and dense elsewhere, for the
+    dense families; pipeline models accept it (their core is dense by
+    construction)."""
+    import jax
+
+    from tpunet.models.vit import make_attn_fn
+
+    fn = make_attn_fn(dataclasses.replace(LMPP_CFG, name="lm",
+                                          attention="auto"), causal=True)
+    expected = ("flash_attention"
+                if jax.default_backend() == "tpu" else "dense_attention")
+    assert fn.func.__name__ == expected
+    create_model(dataclasses.replace(LMPP_CFG, attention="auto"))
+
+
 def test_lmpp_rejects_unsupported_features():
     with pytest.raises(ValueError, match="dense"):
         create_model(dataclasses.replace(LMPP_CFG, attention="ring"))

@@ -103,11 +103,14 @@ class ModelConfig:
     vit_heads: int = 3
     vit_mlp_ratio: float = 4.0
     # Core attention implementation for attention models:
-    # dense | blockwise (chunked K/V, bounded memory) | flash (Pallas
-    # TPU kernel: fused online softmax, scores stay in VMEM; dense
-    # fallback off-TPU) | ring (sequence-parallel K/V rotation over the
-    # mesh 'seq' axis) | ulysses (sequence-parallel via two
-    # all-to-alls, heads resharded).
+    # auto (flash on TPU — it wins every measured regime, README
+    # long-context table — dense elsewhere) | dense | blockwise
+    # (chunked K/V, bounded memory) | flash (Pallas TPU kernel: fused
+    # online softmax, scores stay in VMEM; dense fallback off-TPU) |
+    # ring (sequence-parallel K/V rotation over the mesh 'seq' axis) |
+    # ulysses (sequence-parallel via two all-to-alls, heads resharded).
+    # Default stays 'dense': the cross-backend reference semantics;
+    # pass --attention auto (or flash) on TPUs.
     attention: str = "dense"
     # K/V chunk for attention="blockwise"; block_q/block_k for "flash".
     attention_block: int = 512
@@ -329,8 +332,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatches per step (vit_pp)")
     p.add_argument("--attention", default=None,
-                   choices=["dense", "blockwise", "flash", "ring",
-                            "ulysses"],
+                   choices=["auto", "dense", "blockwise", "flash",
+                            "ring", "ulysses"],
                    help="core attention impl for ViT/LM models; 'flash' "
                         "is the fused Pallas TPU kernel (dense fallback "
                         "off-TPU); 'ring' and 'ulysses' are "

@@ -184,7 +184,7 @@ def to_transformer_lm_params(params: dict) -> dict:
 
 def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
     """Build a PipelinedLM; unsupported 'lm' features fail loudly."""
-    if cfg.attention != "dense":
+    if cfg.attention not in ("dense", "auto"):
         raise ValueError(
             f"lm_pp supports dense (causal) attention only (got "
             f"{cfg.attention!r}); ring/ulysses cannot nest inside the "

@@ -26,6 +26,7 @@ TPU-first choices:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Optional
 
 import jax
@@ -255,6 +256,14 @@ def make_attn_fn(cfg: ModelConfig, mesh=None, causal: bool = False) -> AttnFn:
     sequence sharding (global positions, tpunet/ops/attention.py).
     """
     import functools
+    if cfg.attention == "auto":
+        # Measured policy (README long-context table): the flash kernel
+        # wins every regime on TPU; elsewhere flash_attention itself
+        # falls back to dense, so 'auto' == flash with dense semantics
+        # off-TPU. Resolved at model build time.
+        cfg = dataclasses.replace(
+            cfg, attention=("flash" if jax.default_backend() == "tpu"
+                            else "dense"))
     if cfg.attention == "dense":
         return functools.partial(dense_attention, causal=causal)
     if cfg.attention == "blockwise":

@@ -190,7 +190,7 @@ class PipelinedViT(nn.Module):
 
 def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
     """Build a PipelinedViT. Unsupported 'vit' features fail loudly."""
-    if cfg.attention != "dense":
+    if cfg.attention not in ("dense", "auto"):
         raise ValueError(
             f"vit_pp supports dense attention only (got "
             f"{cfg.attention!r}); ring/blockwise cannot nest inside the "
