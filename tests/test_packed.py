@@ -1,0 +1,180 @@
+"""Packed-sequence LM training (--pack-docs): document packing with
+segment ids, segment-masked attention through the model, boundary-
+masked loss/metrics, and the end-to-end CLI path."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, OptimConfig, TrainConfig)
+from tpunet.data.lm import text_lm_packed
+from tpunet.models import create_model, init_variables
+from tpunet.train.loop import Trainer
+
+LM_CFG = ModelConfig(name="lm", vit_hidden=64, vit_depth=2, vit_heads=4,
+                     dropout_rate=0.0, dtype="float32", vocab_size=256,
+                     max_seq_len=64)
+
+
+def test_packing_structure(tmp_path):
+    path = tmp_path / "docs.txt"
+    # docs of lengths 10, 20, 10, 50 (splits), 5 at seq_len 32
+    path.write_bytes(b"\n".join([b"a" * 10, b"b" * 20, b"c" * 10,
+                                 b"d" * 50, b"e" * 5]))
+    tx, ty, sx, sy = text_lm_packed(str(path), seq_len=32, train_frac=0.5)
+    allx = np.concatenate([tx, sx])
+    ally = np.concatenate([ty, sy])
+    # no doc straddles a row: within a row, each segment id's tokens are
+    # contiguous and share one byte value (by construction of the corpus)
+    for row, seg in zip(allx, ally):
+        for s in np.unique(seg):
+            sel = row[seg == s]
+            if s == 0:
+                assert (sel == 0).all()          # padding
+            else:
+                assert len(np.unique(sel)) == 1  # one doc, one byte value
+        # segment ids are 1..k then 0-padding, non-interleaved
+        nz = seg[seg != 0]
+        assert (np.diff(nz) >= 0).all()
+    # every input byte survived packing
+    assert (allx != 0).sum() == 10 + 20 + 10 + 50 + 5
+
+
+def test_packed_target_weights():
+    from tpunet.train.steps import _packed_target_weights
+    segs = jnp.asarray([[1, 1, 1, 2, 2, 0, 0, 0]])
+    wt = np.asarray(_packed_target_weights(segs))[0]
+    # [T-1] weights: targets at positions 1,2 (within doc1) and 4
+    # (within doc2) are valid; the doc boundary (pos 3) and pad are not
+    np.testing.assert_array_equal(wt, [1, 1, 0, 1, 0, 0, 0])
+
+
+def test_model_segment_isolation():
+    """With segment ids, each packed document's logits equal the same
+    document run alone — nothing leaks across the packed boundary
+    (model-level counterpart of the kernel's cross-segment test)."""
+    model = create_model(LM_CFG)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=32)
+    rng = np.random.default_rng(0)
+    d1 = rng.integers(1, 256, 12)
+    d2 = rng.integers(1, 256, 20)
+    toks = jnp.asarray(np.concatenate([d1, d2])[None], jnp.int32)
+    segs = jnp.asarray(np.concatenate([np.full(12, 1),
+                                       np.full(20, 2)])[None], jnp.int32)
+    packed = model.apply(variables, toks, train=False, segment_ids=segs)
+    alone1 = model.apply(variables, jnp.asarray(d1[None], jnp.int32),
+                         train=False)
+    np.testing.assert_allclose(np.asarray(packed[0, :12]),
+                               np.asarray(alone1[0]), rtol=2e-4,
+                               atol=2e-4)
+    # NOTE d2 alone is NOT compared: positions differ (packed d2 sits at
+    # absolute positions 12..31 and learned position embeddings are
+    # absolute, matching how packed training actually sees documents).
+    # Instead: changing d1's content must not change d2's logits.
+    toks2 = toks.at[:, :12].set((toks[:, :12] + 5) % 256)
+    packed2 = model.apply(variables, toks2, train=False, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(packed[0, 12:]),
+                               np.asarray(packed2[0, 12:]), rtol=2e-5,
+                               atol=2e-5)
+    assert not np.allclose(np.asarray(packed[0, :12]),
+                           np.asarray(packed2[0, :12]))
+
+
+@pytest.mark.slow
+def test_packed_training_end_to_end(tmp_path):
+    """Train on packed repeated documents: deterministic within-doc
+    structure must be learned (accuracy high on valid targets), and
+    metrics must count ONLY valid targets."""
+    path = tmp_path / "docs.txt"
+    path.write_bytes(b"\n".join([b"abcdefgh" * 3] * 200))  # 24-byte docs
+    cfg = TrainConfig(
+        epochs=6,
+        data=DataConfig(dataset="text_lm", text_path=str(path),
+                        batch_size=16, seq_len=48, vocab_size=256,
+                        pack_docs=True),
+        model=LM_CFG,
+        optim=OptimConfig(learning_rate=1e-2, schedule="constant"),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "ck"),
+                                    save_last=False),
+    )
+    trainer = Trainer(cfg)
+    try:
+        history = trainer.train()
+    finally:
+        trainer.close()
+    final = history[-1]
+    assert final["train_accuracy"] > 0.85, final
+    # metric count excludes boundary/padding targets: with 48-byte rows
+    # of two 24-byte docs, valid targets are 23 per doc, 46 per row
+    # (not 47 = T-1)
+    assert np.isfinite(final["test_loss"])
+
+
+def test_pack_docs_cli_and_validation(tmp_path):
+    from tpunet.config import config_from_args
+    path = tmp_path / "c.txt"
+    path.write_bytes(b"\n".join([b"hello world"] * 40))
+    cfg = config_from_args(["--dataset", "text_lm", "--text-file",
+                            str(path), "--model", "lm", "--pack-docs",
+                            "--seq-len", "32", "--batch-size", "8",
+                            "--epochs", "1"])
+    assert cfg.data.pack_docs
+    bad = cfg.replace(model=dataclasses.replace(cfg.model,
+                                                attention="ring"),
+                      mesh=MeshConfig(seq=2))
+    with pytest.raises(ValueError, match="segment-capable"):
+        Trainer(bad)
+    vit = cfg.replace(model=dataclasses.replace(cfg.model,
+                                                name="mobilenet_v2"))
+    with pytest.raises(ValueError):
+        Trainer(vit)
+    # pack_docs with a non-text_lm dataset: its labels are NOT segment
+    # ids — rejected up front, not an opaque trace-time IndexError
+    synth = cfg.replace(data=dataclasses.replace(
+        cfg.data, dataset="synthetic_lm", synthetic_train_size=16,
+        synthetic_test_size=8))
+    with pytest.raises(ValueError, match="text_lm"):
+        Trainer(synth)
+
+
+@pytest.mark.slow
+def test_packed_grad_accum_weights_by_valid_count(tmp_path):
+    """Packed microbatches have UNEQUAL valid-target counts, so grad
+    accumulation must weight microbatch gradients by count: accum=2
+    must match accum=1 on the same global batch."""
+    path = tmp_path / "docs.txt"
+    # wildly uneven doc lengths -> uneven per-row valid counts
+    docs = ([b"x" * 40] * 8 + [b"y" * 4] * 40) * 4
+    path.write_bytes(b"\n".join(docs))
+
+    def run(accum):
+        cfg = TrainConfig(
+            epochs=1,
+            data=DataConfig(dataset="text_lm", text_path=str(path),
+                            batch_size=16, seq_len=48, vocab_size=256,
+                            pack_docs=True),
+            model=LM_CFG,
+            optim=OptimConfig(learning_rate=1e-3, grad_accum=accum),
+            mesh=MeshConfig(data=2),
+            checkpoint=CheckpointConfig(save_best=False,
+                                        save_last=False),
+        )
+        tr = Trainer(cfg)
+        try:
+            m = tr.train_one_epoch(1)
+            leaf = np.asarray(
+                jax.tree_util.tree_leaves(tr.state.params)[0])
+        finally:
+            tr.close()
+        return m, leaf
+
+    m1, p1 = run(1)
+    m2, p2 = run(2)
+    assert abs(m1["loss"] - m2["loss"]) < 1e-4
+    assert m1["count"] == m2["count"]
+    np.testing.assert_allclose(p1, p2, rtol=2e-4, atol=2e-5)

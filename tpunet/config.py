@@ -69,6 +69,11 @@ class DataConfig:
     seq_len: int = 128
     vocab_size: int = 256
     text_path: str = ""
+    # text_lm only: split the corpus into newline-delimited documents
+    # and PACK them into seq_len rows with per-token segment ids;
+    # attention and the next-token loss are then masked so nothing
+    # crosses a document boundary or touches padding.
+    pack_docs: bool = False
     # Deviation from torch DistributedSampler (which pads shards to equal
     # length, :119-124): we drop the train remainder and evaluate the test
     # set exactly (padding with masked examples), which also fixes the
@@ -310,6 +315,10 @@ def build_argparser() -> argparse.ArgumentParser:
                             "text_lm"])
     p.add_argument("--text-file", default=None,
                    help="byte-level corpus file for --dataset text_lm")
+    p.add_argument("--pack-docs", action="store_true",
+                   help="text_lm: pack newline-delimited documents into "
+                        "seq_len rows with segment-masked attention and "
+                        "loss (no cross-document attention/prediction)")
     p.add_argument("--no-download", action="store_true",
                    help="never fetch CIFAR-10/pretrained weights over "
                         "the network; fail with drop-in instructions "
@@ -426,6 +435,8 @@ def config_from_args(argv=None) -> TrainConfig:
         data = dataclasses.replace(data, download=False)
     if args.text_file is not None:
         data = dataclasses.replace(data, text_path=args.text_file)
+    if args.pack_docs:
+        data = dataclasses.replace(data, pack_docs=True)
     if args.mixup is not None:
         data = dataclasses.replace(data, mixup_alpha=args.mixup)
     if args.cutmix is not None:

@@ -64,6 +64,57 @@ def text_lm(path: str, seq_len: int, train_frac: float = 0.9) -> Arrays:
             test_x, np.zeros(len(test_x), np.int32))
 
 
+def text_lm_packed(path: str, seq_len: int,
+                   train_frac: float = 0.9) -> Arrays:
+    """Byte-level PACKED LM dataset: the file is split into documents
+    on newlines, documents are greedily packed into ``seq_len`` rows
+    (no document straddles a row boundary; over-long documents are
+    split), and each row carries per-token SEGMENT IDS in the y slot —
+    1..k for the row's documents, 0 for tail padding. Trained with the
+    segment-masked attention (tpunet/ops/flash.py segment_ids) and the
+    packed LM step, tokens never attend — and the loss never predicts —
+    across document boundaries or into padding.
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+    docs = [d for d in raw.split(b"\n") if d]
+    if not docs:
+        raise ValueError(f"{path!r} has no non-empty lines to pack")
+    rows, segs = [], []
+    cur = np.zeros(seq_len, np.int32)
+    cur_seg = np.zeros(seq_len, np.int32)
+    pos, seg_id = 0, 0
+
+    def flush():
+        nonlocal cur, cur_seg, pos, seg_id
+        if pos:
+            rows.append(cur)
+            segs.append(cur_seg)
+            cur = np.zeros(seq_len, np.int32)
+            cur_seg = np.zeros(seq_len, np.int32)
+            pos, seg_id = 0, 0
+
+    for doc in docs:
+        toks = np.frombuffer(doc, np.uint8).astype(np.int32)
+        for start in range(0, len(toks), seq_len):   # split long docs
+            piece = toks[start:start + seq_len]
+            if pos + len(piece) > seq_len:
+                flush()
+            seg_id += 1
+            cur[pos:pos + len(piece)] = piece
+            cur_seg[pos:pos + len(piece)] = seg_id
+            pos += len(piece)
+    flush()
+    if len(rows) < 2:
+        raise ValueError(
+            f"{path!r} packs into {len(rows)} row(s); need at least 2 "
+            f"for a train/test split (more text or smaller --seq-len)")
+    x = np.stack(rows)
+    y = np.stack(segs)
+    n_train = min(len(x) - 1, max(1, int(round(len(x) * train_frac))))
+    return x[:n_train], y[:n_train], x[n_train:], y[n_train:]
+
+
 def get_lm_dataset(cfg: DataConfig) -> Arrays:
     if cfg.dataset == "synthetic_lm":
         return synthetic_lm(cfg.synthetic_train_size,
@@ -76,5 +127,7 @@ def get_lm_dataset(cfg: DataConfig) -> Arrays:
             raise ValueError(
                 f"text_lm is byte-level: vocab_size must be >= 256, got "
                 f"{cfg.vocab_size}")
+        if cfg.pack_docs:
+            return text_lm_packed(cfg.text_path, cfg.seq_len)
         return text_lm(cfg.text_path, cfg.seq_len)
     raise ValueError(f"unknown LM dataset {cfg.dataset!r}")

@@ -102,7 +102,9 @@ def eval_batches(
         stop = min(start + local, n) if start < n else start
         count = max(0, stop - start)
         x = np.zeros((local,) + images.shape[1:], dtype=images.dtype)
-        y = np.zeros((local,), dtype=labels.dtype)
+        # labels may be per-example scalars OR per-token rows (packed
+        # LM segment ids) — pad with whatever trailing shape they have.
+        y = np.zeros((local,) + labels.shape[1:], dtype=labels.dtype)
         m = np.zeros((local,), dtype=np.float32)
         if count:
             x[:count] = images[start:stop]

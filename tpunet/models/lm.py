@@ -53,10 +53,12 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False,
-                 pos_offset=0):
+                 pos_offset=0, segment_ids=None):
         """``decode=True``: incremental step against the KV cache (one
         token per call after cache init); ``pos_offset`` is the absolute
-        position of ``tokens[:, 0]`` in the sequence."""
+        position of ``tokens[:, 0]`` in the sequence. ``segment_ids``
+        [B, T] enables packed-sequence training: attention is masked to
+        same-segment tokens (composed with causality in the core)."""
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -83,7 +85,8 @@ class TransformerLM(nn.Module):
                              moe_capacity_factor=self.moe_capacity_factor,
                              dropout_rate=self.dropout_rate,
                              dtype=self.dtype, param_dtype=self.param_dtype,
-                             name=f"block{i:02d}")(x, train, decode)
+                             name=f"block{i:02d}")(x, train, decode,
+                                                   segment_ids)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln")(x)
         # Tied output head: logits against the embedding matrix.

@@ -57,7 +57,8 @@ class Attention(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False, decode: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False,
+                 segment_ids=None):
         b, t, c = x.shape
         if c % self.heads:
             raise ValueError(
@@ -69,6 +70,13 @@ class Attention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if decode:
             y = self._decode_attend(q, k, v)
+        elif segment_ids is not None:
+            # Packed sequences: same-segment masking in the core. Only
+            # the dense/flash cores take the kwarg — the sequence-
+            # parallel cores raise a TypeError here by design (config
+            # validation rejects the combination up front).
+            y = self.attn_fn(q, k, v,
+                             segment_ids=(segment_ids, segment_ids))
         else:
             y = self.attn_fn(q, k, v)
         y = y.reshape(b, t, c)
@@ -148,13 +156,14 @@ class EncoderBlock(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False, decode: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False,
+                 segment_ids=None):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln1")(x)
         x = x + Attention(self.heads, attn_fn=self.attn_fn,
                           dropout_rate=self.dropout_rate, dtype=self.dtype,
                           param_dtype=self.param_dtype,
-                          name="attn")(y, train, decode)
+                          name="attn")(y, train, decode, segment_ids)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln2")(x)
         if self.moe_experts > 0:

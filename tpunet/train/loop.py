@@ -134,13 +134,30 @@ class Trainer:
             gather_sh = tree_shardings(
                 state.params, self.mesh,
                 rules_for(cfg.model, mesh=self.mesh))
+        packed = cfg.data.pack_docs
+        if packed:
+            if cfg.data.dataset != "text_lm":
+                raise ValueError(
+                    f"--pack-docs packs text_lm documents; dataset is "
+                    f"{cfg.data.dataset!r} (its labels are not segment "
+                    "ids)")
+            if not self.is_lm or cfg.model.name != "lm":
+                raise ValueError("--pack-docs needs --model lm (the "
+                                 "segment-masked attention path)")
+            if cfg.model.attention not in ("dense", "flash", "auto"):
+                raise ValueError(
+                    f"--pack-docs needs a segment-capable attention "
+                    f"core (dense/flash/auto), got "
+                    f"{cfg.model.attention!r}")
         train_fn = (make_lm_train_step(cfg.optim, cfg.model, self.mesh,
-                                       gather_params=gather_sh)
+                                       gather_params=gather_sh,
+                                       packed=packed)
                     if self.is_lm
                     else make_train_step(cfg.data, cfg.optim, cfg.model,
                                          self.mesh,
                                          gather_params=gather_sh))
-        eval_fn = (make_lm_eval_step(gather_params=gather_sh) if self.is_lm
+        eval_fn = (make_lm_eval_step(gather_params=gather_sh,
+                                     packed=packed) if self.is_lm
                    else make_eval_step(cfg.data, gather_params=gather_sh))
         self.train_step = jax.jit(
             train_fn,
@@ -152,9 +169,13 @@ class Trainer:
             in_shardings=(state_sh, bsh, bsh, bsh))
 
         self._prefetcher = None
-        if cfg.data.native_loader and not cfg.eval_only:
+        if (cfg.data.native_loader and not cfg.eval_only
+                and not cfg.data.pack_docs):
             # The native gather moves raw bytes per row, so uint8 image
-            # rows and int32 token rows share the same path.
+            # rows and int32 token rows share the same path. Packed
+            # datasets carry [B, T] segment ids in the label slot, which
+            # the prefetcher's scalar-label ABI doesn't cover — numpy
+            # path there.
             from tpunet.data import native
             if native.available():
                 local = cfg.data.batch_size // jax.process_count()

@@ -45,7 +45,8 @@ def _with_aux(loss, mutated, aux_weight: float):
 
 
 def _steps_from_micro(micro: Callable, accum: int, mesh,
-                      gather_params=None, ema_decay: float = 0.0) -> Callable:
+                      gather_params=None, ema_decay: float = 0.0,
+                      weight_by_count: bool = False) -> Callable:
     """Lift micro(params, batch_stats, apply_fn, x, y, rng) ->
     (grads, new_stats, metrics) into train_step(state, x, y, rng).
 
@@ -54,6 +55,11 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
     scanned *in time* — gradients averaged (mean of equal-sized means ==
     the full-batch mean), BatchNorm stats threaded through microbatches
     (torch semantics: stats update every forward), ONE optimizer update.
+    ``weight_by_count`` (packed sequences): microbatch example counts
+    are UNEQUAL (valid-target counts vary with packing), so each
+    microbatch's gradient is weighted by its metrics count and the sum
+    divided by the total — restoring the full-batch mean the equal
+    average would otherwise break.
     Activation memory drops by ~1/accum; the XLA program stays static.
     The split is STRIDED (microbatch i = rows i, i+accum, ...): under
     the P('data') batch layout a contiguous split would move most rows
@@ -114,6 +120,9 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
             mx, my, mr = inp
             grads, stats, m = micro(params, stats, state.apply_fn,
                                     mx, my, mr)
+            if weight_by_count:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * m["count"], grads)
             gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
             return (stats, gsum, M.accumulate(msum, m)), None
 
@@ -121,7 +130,9 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
         (stats, gsum, msum), _ = jax.lax.scan(
             body, (state.batch_stats, gzero, M.zeros_metrics()),
             (xs, ys, rngs))
-        grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
+        denom = (jnp.maximum(msum["count"], 1.0) if weight_by_count
+                 else accum)
+        grads = jax.tree_util.tree_map(lambda g: g / denom, gsum)
         return finish(state, grads, stats), msum
 
     return train_step
@@ -187,59 +198,94 @@ def make_train_step(data_cfg: DataConfig,
                              ema_decay=optim_cfg.ema_decay)
 
 
+def _packed_target_weights(segs):
+    """[B, T-1] float weights for next-token prediction under packing:
+    a target is valid iff it continues the SAME document (segment id
+    unchanged) and is not padding (id 0) — no prediction crosses a
+    document boundary or lands on pad."""
+    return ((segs[:, 1:] == segs[:, :-1])
+            & (segs[:, 1:] != 0)).astype(jnp.float32)
+
+
 def make_lm_train_step(optim_cfg: OptimConfig,
                        model_cfg: ModelConfig,
-                       mesh=None, gather_params=None) -> Callable:
-    """train_step(state, tokens, _labels, rng) -> (state, metrics) for
+                       mesh=None, gather_params=None,
+                       packed: bool = False) -> Callable:
+    """train_step(state, tokens, labels, rng) -> (state, metrics) for
     the LM family: targets are the input shifted by one; metrics count
     next-token predictions (accuracy ~0.8 is ceiling on the synthetic
-    bigram data, tpunet/data/lm.py)."""
+    bigram data, tpunet/data/lm.py). ``packed=True``: ``labels``
+    carries [B, T] segment ids (tpunet/data/lm.py text_lm_packed) —
+    attention is segment-masked inside the model and the loss/metrics
+    drop cross-document and padding targets."""
     aux_weight = model_cfg.moe_aux_weight
     smoothing = optim_cfg.label_smoothing
 
-    def micro(params, batch_stats, apply_fn, tokens, _labels, rng):
+    def micro(params, batch_stats, apply_fn, tokens, labels, rng):
+        segs = labels if packed else None
+
         def loss_fn(params):
+            kwargs = {"segment_ids": segs} if packed else {}
             logits, mutated = apply_fn(
                 {"params": params, "batch_stats": batch_stats},
                 tokens, train=True,
                 rngs={"dropout": rng},
-                mutable=["batch_stats", "losses"])
+                mutable=["batch_stats", "losses"], **kwargs)
             lg, tgt = logits[:, :-1], tokens[:, 1:]
-            loss = _with_aux(_ce_loss(lg, tgt, smoothing).mean(),
-                             mutated, aux_weight)
+            ce = _ce_loss(lg, tgt, smoothing)
+            if packed:
+                wt = _packed_target_weights(segs)
+                n_valid = jnp.maximum(jnp.sum(wt), 1.0)
+                ce_mean = jnp.sum(ce * wt) / n_valid
+            else:
+                ce_mean = ce.mean()
+            loss = _with_aux(ce_mean, mutated, aux_weight)
             return loss, (lg, tgt, mutated.get("batch_stats", {}))
 
         (loss, (lg, tgt, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        n = tgt.size
-        correct = jnp.sum(jnp.argmax(lg, -1) == tgt)
+        hit = (jnp.argmax(lg, -1) == tgt).astype(jnp.float32)
+        if packed:
+            wt = _packed_target_weights(segs)
+            n = jnp.sum(wt)
+            correct = jnp.sum(hit * wt)
+        else:
+            n = tgt.size
+            correct = jnp.sum(hit)
         return grads, new_stats, M.from_batch(loss * n, correct, n)
 
     return _steps_from_micro(micro, max(1, optim_cfg.grad_accum), mesh,
                              gather_params=gather_params,
-                             ema_decay=optim_cfg.ema_decay)
+                             ema_decay=optim_cfg.ema_decay,
+                             weight_by_count=packed)
 
 
-def make_lm_eval_step(gather_params=None) -> Callable:
-    """eval_step(state, tokens, _labels, mask) -> metrics; ``mask`` [B]
+def make_lm_eval_step(gather_params=None, packed: bool = False) -> Callable:
+    """eval_step(state, tokens, labels, mask) -> metrics; ``mask`` [B]
     zeroes padded sequences so the test set is counted exactly.
+    ``packed=True``: ``labels`` carries [B, T] segment ids, composing
+    the per-sequence mask with the per-token packing weights.
     ``gather_params``: FSDP compute-layout tree, same as the train step
     (without it the eval forward re-runs under the pathological GSPMD
     propagation the train step avoids)."""
 
-    def eval_step(state: TrainState, tokens, _labels, mask):
+    def eval_step(state: TrainState, tokens, labels, mask):
         params = state.params
         if gather_params is not None:
             params = jax.lax.with_sharding_constraint(params, gather_params)
+        kwargs = {"segment_ids": labels} if packed else {}
         logits = state.apply_fn(
             {"params": params, "batch_stats": state.batch_stats},
-            tokens, train=False)
+            tokens, train=False, **kwargs)
         lg, tgt = logits[:, :-1], tokens[:, 1:]
         losses = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
         wt = mask[:, None]
+        if packed:
+            wt = wt * _packed_target_weights(labels)
         correct = (jnp.argmax(lg, -1) == tgt).astype(jnp.float32)
         return M.from_batch(jnp.sum(losses * wt), jnp.sum(correct * wt),
-                            jnp.sum(wt) * tgt.shape[1])
+                            jnp.sum(wt) if packed
+                            else jnp.sum(wt) * tgt.shape[1])
 
     return eval_step
 
