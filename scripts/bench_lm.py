@@ -75,8 +75,9 @@ def main() -> None:
               "skipping it", file=sys.stderr, flush=True)
         args.attention = [a for a in args.attention if a != "flash"]
 
-    if args.model == "lm_pp":
-        args.attention = ["dense"]     # the pipelined blocks' only core
+    if args.model == "lm_pp" and set(args.attention) - {"dense", "flash",
+                                                        "auto"}:
+        args.attention = ["auto"]      # pipelined blocks: dense/flash only
 
     results = {}
     for attn in args.attention:

@@ -28,13 +28,17 @@ in this module: generation/serving unstacks lm_pp checkpoints into the
 serving at all (SURVEY.md section 0 — this whole family is beyond
 parity).
 
-Measured cost of the formulation (v5e chip, scripts/bench_lm.py
---model lm_pp, T=2048 B=8 depth=4 hidden=512): 132k tok/s at pipe=1 vs
-157k for the unrolled dense TransformerLM — scan-over-layers gives up
-~16% of XLA's inter-layer fusion; that overhead is the price of being
-shardable over 'pipe', which pays only at real multi-stage meshes
-(unmeasurable on this 1-chip environment; the dp x pp dryrun leg
-validates the program, not its scaling).
+Measured on the v5e chip (scripts/bench_lm.py --model lm_pp, T=2048
+B=8 depth=4 hidden=512): 276-290k tok/s at pipe=1 with the flash core
+(--attention flash/auto; inside the pipeline's shard_map the local
+kernel variant runs, outside it the custom_partitioning-wrapped one —
+resolve_block_cores) — 1.85x the
+unrolled DENSE TransformerLM (157k) and within 19% of the unrolled
+flash one (357k); that residual scan-over-layers overhead is the price
+of being shardable over 'pipe', which pays only at real multi-stage
+meshes (unmeasurable on this 1-chip environment; the dp x pp dryrun
+leg validates the program, not its scaling). With the dense core this
+was 132k tok/s.
 
 Schedule note: the executor is plain GPipe (bubble (S-1)/(M+S-1)).
 A hand-scheduled 1F1B would need manual VJP orchestration — JAX's
@@ -57,7 +61,7 @@ from flax import linen as nn
 
 from tpunet.config import ModelConfig
 from tpunet.models.vit_pp import (_dropout, _stacked_lecun_normal,
-                                  block_apply)
+                                  block_apply, resolve_block_cores)
 from tpunet.parallel.pp import gpipe
 
 
@@ -72,6 +76,7 @@ class PipelinedLM(nn.Module):
     max_len: int = 1024
     n_micro: int = 4
     dropout_rate: float = 0.0
+    attention: str = "dense"           # dense | flash | auto
     mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -133,17 +138,23 @@ class PipelinedLM(nn.Module):
             lambda a: a.astype(self.dtype), blocks)
         heads = self.heads
 
+        seq_core, pipe_core = resolve_block_cores(self.attention)
+        pipelined = (self.mesh is not None
+                     and self.mesh.shape.get("pipe", 1) > 1)
+        attn = pipe_core if pipelined else seq_core
+
         def stage_apply(params, xs, k=None):
             def body(carry, inp):
                 pl, i = inp
                 lk = (jax.random.fold_in(k, i) if k is not None else None)
                 return block_apply(pl, carry, heads=heads, causal=True,
-                                   dropout_rate=rate, key=lk), None
+                                   dropout_rate=rate, key=lk,
+                                   attn=attn), None
             idx = jnp.arange(jax.tree_util.tree_leaves(params)[0].shape[0])
             out, _ = jax.lax.scan(body, xs, (params, idx))
             return out
 
-        if self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1:
+        if pipelined:
             x = gpipe(stage_apply, blocks, x, mesh=self.mesh,
                       n_micro=self.n_micro, key=key)
         else:
@@ -184,9 +195,9 @@ def to_transformer_lm_params(params: dict) -> dict:
 
 def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
     """Build a PipelinedLM; unsupported 'lm' features fail loudly."""
-    if cfg.attention not in ("dense", "auto"):
+    if cfg.attention not in ("dense", "flash", "auto"):
         raise ValueError(
-            f"lm_pp supports dense (causal) attention only (got "
+            f"lm_pp supports dense/flash/auto (causal) attention (got "
             f"{cfg.attention!r}); ring/ulysses cannot nest inside the "
             "pipeline's shard_map")
     if cfg.moe_experts > 0:
@@ -209,6 +220,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
         max_len=cfg.max_seq_len,
         n_micro=cfg.pp_microbatches,
         dropout_rate=cfg.dropout_rate,
+        attention=cfg.attention,
         mesh=mesh,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),

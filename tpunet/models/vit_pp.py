@@ -16,10 +16,12 @@ Patch embed, final LN and the classifier head are tiny; they run
 replicated on every pipe stage rather than being assigned to first/last
 stages (standard trick — keeps the pipeline body uniform).
 
-Differences from the dense ViT (documented, deliberate): dense attention
-only (ring attention's own shard_map cannot nest inside the pipeline's).
-Dropout IS supported: a PRNG key threads through the GPipe executor,
-folded per (tick, stage, layer) — see block_apply.
+Differences from the dense ViT (documented, deliberate): the attention
+core is dense, flash, or auto only (ring/blockwise's own shard_map
+cannot nest inside the pipeline's); flash picks the kernel variant by
+context — see resolve_block_cores. Dropout IS supported: a PRNG key
+threads through the GPipe executor, folded per (tick, stage, layer) —
+see block_apply.
 """
 
 from __future__ import annotations
@@ -32,7 +34,25 @@ from flax import linen as nn
 
 from tpunet.config import ModelConfig
 from tpunet.ops import dense_attention
+from tpunet.ops.flash import flash_attention, local_flash_attention
 from tpunet.parallel.pp import gpipe
+
+
+def resolve_block_cores(attention: str):
+    """(sequential_core, pipelined_core) for a pipeline model's blocks.
+
+    'dense' honors the explicit request everywhere. 'flash'/'auto' use
+    the fused kernel — but the VARIANT matters: inside the pipeline's
+    shard_map the per-shard local kernel is correct (GSPMD is already
+    done), while the sequential pipe==1 path runs under the top-level
+    jit where only the custom_partitioning-wrapped entry keeps a
+    batch-sharded mesh from all-gathering q/k/v at every layer (the
+    failure mode tpunet/ops/flash.py's partitioning section documents).
+    Both fall back to dense off-TPU.
+    """
+    if attention == "dense":
+        return dense_attention, dense_attention
+    return flash_attention, local_flash_attention
 
 
 def _stacked_lecun_normal(key, shape, dtype=jnp.float32):
@@ -62,20 +82,22 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
 
 
-def block_apply(p, x, *, heads, causal=False, dropout_rate=0.0, key=None):
+def block_apply(p, x, *, heads, causal=False, dropout_rate=0.0, key=None,
+                attn=dense_attention):
     """One pre-LN encoder block from a dict of per-layer params.
 
     Mirrors tpunet/models/vit.py's EncoderBlock: dropout (when
     ``dropout_rate > 0`` and ``key`` is given) applies after the
     attention out-projection and after the MLP's second dense, exactly
     the flax module's placements; ``causal=True`` is the LM family's
-    autoregressive mask."""
+    autoregressive mask. ``attn`` is the core from
+    :func:`resolve_block_cores` (dense, or the flash kernel variant
+    matching the calling context)."""
     mb, t, c = x.shape
     y = _layer_norm(x, p["ln1s"], p["ln1b"])
     qkv = y @ p["qkv_k"] + p["qkv_b"]
     qkv = qkv.reshape(mb, t, 3, heads, c // heads)
-    a = dense_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-                        causal=causal)
+    a = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=causal)
     a = a.reshape(mb, t, c) @ p["out_k"] + p["out_b"]
     if dropout_rate > 0.0 and key is not None:
         ka, km = jax.random.split(key)
@@ -100,6 +122,7 @@ class PipelinedViT(nn.Module):
     mlp_ratio: float = 4.0
     n_micro: int = 4
     dropout_rate: float = 0.0
+    attention: str = "dense"           # dense | flash | auto
     mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -161,17 +184,23 @@ class PipelinedViT(nn.Module):
         if key is not None:
             x = _dropout(x, rate, self.make_rng("dropout"))
 
+        seq_core, pipe_core = resolve_block_cores(self.attention)
+        pipelined = (self.mesh is not None
+                     and self.mesh.shape.get("pipe", 1) > 1)
+        attn = pipe_core if pipelined else seq_core
+
         def stage_apply(params, xs, k=None):
             def body(carry, inp):
                 pl, i = inp
                 lk = (jax.random.fold_in(k, i) if k is not None else None)
                 return block_apply(pl, carry, heads=heads,
-                                   dropout_rate=rate, key=lk), None
+                                   dropout_rate=rate, key=lk,
+                                   attn=attn), None
             idx = jnp.arange(jax.tree_util.tree_leaves(params)[0].shape[0])
             out, _ = jax.lax.scan(body, xs, (params, idx))
             return out
 
-        if self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1:
+        if pipelined:
             x = gpipe(stage_apply, blocks, x, mesh=self.mesh,
                       n_micro=self.n_micro, key=key)
         else:
@@ -190,9 +219,9 @@ class PipelinedViT(nn.Module):
 
 def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
     """Build a PipelinedViT. Unsupported 'vit' features fail loudly."""
-    if cfg.attention not in ("dense", "auto"):
+    if cfg.attention not in ("dense", "flash", "auto"):
         raise ValueError(
-            f"vit_pp supports dense attention only (got "
+            f"vit_pp supports dense/flash/auto attention (got "
             f"{cfg.attention!r}); ring/blockwise cannot nest inside the "
             "pipeline's shard_map")
     if cfg.moe_experts > 0:
@@ -211,6 +240,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
         mlp_ratio=cfg.vit_mlp_ratio,
         n_micro=cfg.pp_microbatches,
         dropout_rate=cfg.dropout_rate,
+        attention=cfg.attention,
         mesh=mesh,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),
