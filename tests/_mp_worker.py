@@ -44,6 +44,41 @@ def fsdp_lm_case():
     return cfg, synthetic_lm(64, 32, seq_len=32, vocab=32, seed=7)
 
 
+def packed_lm_case(tmp_dir=None):
+    """(cfg, dataset) for the packed-sequence LM case: both controllers
+    train on packed documents with [B, T] segment-id labels crossing
+    the process boundary — exercises 2-D label sharding, the
+    segment-masked step, and count-weighted metrics multi-controller.
+    Each process writes its OWN copy of the (deterministic, identical)
+    corpus — a shared path would race: the workers reach this right
+    after the rendezvous, and one could read the file mid-truncation.
+    """
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.data.lm import text_lm_packed
+
+    tmp_dir = tmp_dir or f"/tmp/tpunet-mp-packed-{os.getpid()}"
+    os.makedirs(tmp_dir, exist_ok=True)
+    path = os.path.join(tmp_dir, "docs.txt")
+    docs = ([b"alpha beta gamma delta"] * 30 + [b"tiny"] * 60) * 2
+    with open(path, "wb") as f:
+        f.write(b"\n".join(docs))
+    cfg = TrainConfig(
+        epochs=1, seed=42,
+        data=DataConfig(dataset="text_lm", text_path=path,
+                        batch_size=16, seq_len=32, vocab_size=256,
+                        pack_docs=True),
+        model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                          vit_heads=4, dropout_rate=0.0,
+                          dtype="float32", vocab_size=256,
+                          max_seq_len=32),
+        optim=OptimConfig(learning_rate=3e-3),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    return cfg, text_lm_packed(path, seq_len=32)
+
+
 def main():
     coordinator, num_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
@@ -63,6 +98,8 @@ def main():
 
     if mode == "fsdp_lm":
         cfg, ds = fsdp_lm_case()
+    elif mode == "packed_lm":
+        cfg, ds = packed_lm_case()
     else:
         cfg = TrainConfig(
             epochs=1, seed=42,

@@ -92,6 +92,33 @@ def test_two_process_training_parity():
 
 
 @pytest.mark.slow
+def test_two_process_packed_lm():
+    """Packed-sequence training across a REAL process boundary: [B, T]
+    segment-id labels shard over the cross-process data axis, both
+    controllers agree on the count-weighted global metrics, and they
+    match a single-process run of the same global mesh."""
+    a, b = _run_workers(mode="packed_lm")
+    assert a["devices"] == b["devices"] == 8
+    for section in ("eval0", "train1"):
+        assert np.isclose(a[section]["loss"], b[section]["loss"], rtol=1e-6)
+        assert a[section]["count"] == b[section]["count"]
+
+    from tpunet.train.loop import Trainer
+    from _mp_worker import packed_lm_case
+    cfg, ds = packed_lm_case()
+    t = Trainer(cfg, dataset=ds)
+    try:
+        e = t.evaluate()
+        assert e["count"] == a["eval0"]["count"]
+        assert np.isclose(e["loss"], a["eval0"]["loss"], rtol=1e-4)
+        m = t.train_one_epoch(0)
+        assert m["count"] == a["train1"]["count"]
+        assert np.isclose(m["loss"], a["train1"]["loss"], rtol=2e-2)
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
 def test_two_process_fsdp_grad_accum_lm():
     """FSDP (params + moments sharded over the CROSS-PROCESS data axis)
     + grad accumulation on the LM family: both controllers must agree
