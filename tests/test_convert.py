@@ -163,6 +163,7 @@ def test_manifest_self_witness(manifest):
     assert swapped == 2_236_682                # reference :30
 
 
+@pytest.mark.slow
 def test_export_matches_torchvision_manifest(manifest):
     """The exporter emits EXACTLY torchvision's key set and shapes (10-way
     head aside) — fails if the converter's key scheme ever diverges from

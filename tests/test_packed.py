@@ -115,6 +115,7 @@ def test_packed_training_end_to_end(tmp_path):
     assert np.isfinite(final["test_loss"])
 
 
+@pytest.mark.slow
 def test_pack_docs_cli_and_validation(tmp_path):
     from tpunet.config import config_from_args
     path = tmp_path / "c.txt"
