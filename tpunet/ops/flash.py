@@ -36,9 +36,11 @@ scripts/bench_flash.py):
 
   round 1 (rectangular causal grid + @pl.when skip):
     fwd: flash 10.7 ms vs dense 25.6 ms vs blockwise 17.1 ms
-  round 2 (fused TRIANGULAR causal grid, dead copies elided):
-    fwd: flash 8.6 ms (-20% vs round 1; 2.45x dense's 21.1 ms)
-    fwd+bwd: flash 13.0 ms vs dense 39.7 ms (3.1x) vs blockwise 50.7 ms
+  round 2 (fused TRIANGULAR causal grids — fwd, dQ, AND dK/dV (upper
+  triangle via point reflection of the same inversion) — dead copies
+  elided on the remaining rectangular cross-length paths):
+    fwd: flash 8.2-8.6 ms (-20% vs round 1; ~2.5x dense's 20.8 ms)
+    fwd+bwd: flash 12.8 ms vs dense 39.8 ms (3.1x) vs blockwise 50.7 ms
     segments (4 packed docs): 8.0 ms fwd — masking costs ~nothing
 
 End-to-end LM training (fwd + bwd + Adam, the numbers that matter):
@@ -72,6 +74,18 @@ def _tri_qi_ki(t):
     qi = jnp.where(t < qi * (qi + 1) // 2, qi - 1, qi)
     qi = jnp.where(t >= (qi + 1) * (qi + 2) // 2, qi + 1, qi)
     return qi, t - qi * (qi + 1) // 2
+
+
+def _tri_ki_qi_upper(t, nq: int):
+    """Invert the row-major UPPER-triangle linearization used by the
+    dK/dV grid: rows are k blocks, each accumulating q blocks
+    qi = ki..nq-1. Reuses the tested lower-triangle inversion through a
+    point reflection: enumerating the upper triangle forward equals
+    enumerating the lower one backward with both coordinates flipped.
+    """
+    total = nq * (nq + 1) // 2
+    lo_qi, lo_ki = _tri_qi_ki(total - 1 - t)
+    return nq - 1 - lo_qi, nq - 1 - lo_ki      # (ki, qi)
 
 
 def _use_tri(causal, tq, tk, bq, bk) -> bool:
@@ -183,21 +197,45 @@ def _kernel(q_ref, k_ref, v_ref, *refs,
             lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _grid_and_maps(causal, bq, bk, nq, nk, tq, tk, b, h):
-    """(grid, qmap, kvmap, qsegmap, ksegmap) shared by the forward and
-    dQ pallas_calls (identical iteration order). Triangular when
-    eligible — no dead steps at all; else rectangular with the k/v
-    index maps CLAMPED for causal so dead blocks re-reference the
-    previous block and Mosaic elides their copies (same-index
-    revisiting)."""
+def _grid_and_maps(causal, bq, bk, nq, nk, tq, tk, b, h,
+                   transposed: bool = False):
+    """(grid, qmap, kvmap, qsegmap, ksegmap) for the flash pallas_calls.
+
+    Default: the forward/dQ iteration order (q rows, k accumulated) —
+    triangular when eligible (no dead steps at all), else rectangular
+    with the k/v index maps CLAMPED for causal so dead blocks
+    re-reference the previous block and Mosaic elides their copies
+    (same-index revisiting).
+
+    ``transposed``: the dK/dV order (k rows, q accumulated) — the upper
+    triangle when eligible, else rectangular with the q-side maps
+    clamped to the first needed q block of each k row (dead LEADING
+    steps elided the same way).
+    """
     if _use_tri(causal, tq, tk, bq, bk):
-        qi_of = lambda t: _tri_qi_ki(t)[0]
-        ki_of = lambda t: _tri_qi_ki(t)[1]
+        if transposed:
+            qb = lambda t: _tri_ki_qi_upper(t, nq)[1]
+            kb = lambda t: _tri_ki_qi_upper(t, nq)[0]
+        else:
+            qb = lambda t: _tri_qi_ki(t)[0]
+            kb = lambda t: _tri_qi_ki(t)[1]
         return ((b, h, nq * (nq + 1) // 2),
-                lambda b, h, t: (b, h, qi_of(t), 0),
-                lambda b, h, t: (b, h, ki_of(t), 0),
-                lambda b, h, t: (b, qi_of(t), 0),
-                lambda b, h, t: (b, 0, ki_of(t)))
+                lambda b, h, t: (b, h, qb(t), 0),
+                lambda b, h, t: (b, h, kb(t), 0),
+                lambda b, h, t: (b, qb(t), 0),
+                lambda b, h, t: (b, 0, kb(t)))
+    if transposed:
+        if causal:
+            qmin = lambda j: jnp.clip((j * bk - (tk - tq)) // bq,
+                                      0, nq - 1)
+            i_eff = lambda j, i: jnp.maximum(i, qmin(j))
+        else:
+            i_eff = lambda j, i: i
+        return ((b, h, nk, nq),
+                lambda b, h, j, i: (b, h, i_eff(j, i), 0),
+                lambda b, h, j, i: (b, h, j, 0),
+                lambda b, h, j, i: (b, i_eff(j, i), 0),
+                lambda b, h, j, i: (b, 0, j))
     if causal:
         kmax = lambda i: jnp.clip(((i + 1) * bq - 1 + (tk - tq)) // bk,
                                   0, nk - 1)
@@ -385,7 +423,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                 scale, causal, bq, bk, nq, tq, tk, with_glse,
-                with_segments):
+                with_segments, tri):
     if with_glse:
         glse_ref, *refs = refs
         glse = glse_ref[0, 0, :, :1]
@@ -394,14 +432,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
     if with_segments:
         qseg_ref, kseg_ref, *refs = refs
     dk_ref, dv_ref, dk_scr, dv_scr = refs
-    ki, qi = pl.program_id(2), pl.program_id(3)   # note: k outer, q inner
+    if tri:
+        # Fused upper-triangular grid: row ki accumulates qi = ki..nq-1,
+        # exactly the blocks a causal self-attention needs.
+        ki, qi = _tri_ki_qi_upper(pl.program_id(2), nq)
+        first, needed = qi == ki, True
+    else:
+        ki, qi = pl.program_id(2), pl.program_id(3)  # k outer, q inner
+        first = qi == 0
+        needed = ((qi + 1) * bq - 1 + (tk - tq) >= ki * bk) if causal \
+            else True
 
-    @pl.when(qi == 0)
+    @pl.when(first)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
-
-    needed = ((qi + 1) * bq - 1 + (tk - tq) >= ki * bk) if causal else True
 
     @pl.when(needed)
     def _compute():
@@ -479,30 +524,22 @@ def _pallas_backward(q, k, v, out, lse, do,
         interpret=interpret,
     )(qt, kt, vt, dot_, *rows, *segs)
 
-    # dK/dV: same block roles, transposed grid — k block index is grid
-    # axis 2, q block the accumulated axis 3. Dead LEADING q steps of a
-    # causal row clamp their q-side maps to the first needed block, so
-    # their copies are elided (consecutive identical indices).
-    if causal:
-        qmin = lambda j: jnp.clip((j * bk - (tk - tq)) // bq, 0, nq - 1)
-        i_eff = lambda j, i: jnp.maximum(i, qmin(j))
-    else:
-        i_eff = lambda j, i: i
-    qi_spec = pl.BlockSpec((1, 1, bq, d),
-                           lambda b, h, j, i: (b, h, i_eff(j, i), 0))
-    rowi_spec = pl.BlockSpec((1, 1, bq, 128),
-                             lambda b, h, j, i: (b, h, i_eff(j, i), 0))
-    kvj_spec = pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0))
-    segi_specs = [pl.BlockSpec((1, bq, 128),
-                               lambda b, h, j, i: (b, i_eff(j, i), 0)),
-                  pl.BlockSpec((1, 8, bk),
-                               lambda b, h, j, i: (b, 0, j))] \
-        if with_seg else []
+    # dK/dV: same block roles, transposed order — k block index is the
+    # grid row, q block the accumulated axis (the upper triangle when
+    # eligible).
+    grid_dkv, qmap_t, kvmap_t, qsegmap_t, ksegmap_t = _grid_and_maps(
+        causal, bq, bk, nq, nk, tq, tk, b, h, transposed=True)
+    qi_spec = pl.BlockSpec((1, 1, bq, d), qmap_t)
+    rowi_spec = pl.BlockSpec((1, 1, bq, 128), qmap_t)
+    kvj_spec = pl.BlockSpec((1, 1, bk, d), kvmap_t)
+    segi_specs = [pl.BlockSpec((1, bq, 128), qsegmap_t),
+                  pl.BlockSpec((1, 8, bk), ksegmap_t)] if with_seg else []
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, tq=tq, tk=tk,
-                          with_glse=with_glse, with_segments=with_seg),
-        grid=(b, h, nk, nq),
+                          with_glse=with_glse, with_segments=with_seg,
+                          tri=tri),
+        grid=grid_dkv,
         in_specs=[qi_spec, kvj_spec, kvj_spec, qi_spec]
         + [rowi_spec] * len(rows) + segi_specs,
         out_specs=[kvj_spec, kvj_spec],
