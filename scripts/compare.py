@@ -100,6 +100,12 @@ def run_mode(mode: str, env: dict, out_dir: str, common: list[str],
         "steady_images_per_sec": max(r["examples_per_sec"] for r in rows),
         "best_test_accuracy": max(r["test_accuracy"] for r in rows),
         "final_train_loss": rows[-1]["train_loss"],
+        # Per-epoch times make run-to-run variance visible in the
+        # artifact: at 1 process the single and distributed presets
+        # build IDENTICAL configs (tpunet/config.py preset()) and thus
+        # identical XLA programs, so any single/distributed gap at
+        # n_dist=1 is environment noise, measurable from this column.
+        "epoch_seconds": [round(r["seconds"], 2) for r in rows],
     }
 
 

@@ -361,6 +361,12 @@ class TestFlashAttention:
         assert not _use_tri(True, 128, 256, 16, 16)   # cross-length
         assert not _use_tri(True, 256, 256, 16, 32)   # unequal blocks
         assert not _use_tri(False, 256, 256, 16, 16)  # non-causal
+        # float32 sqrt inversion bound: past ~2**23 linearized steps
+        # sqrt's ~2^-24 relative error can exceed the ±1 correction's
+        # reach — fall back to the rectangular grid (nq=4096 -> 8.39M
+        # steps > 2**23)
+        assert _use_tri(True, 2048 * 512, 2048 * 512, 512, 512)
+        assert not _use_tri(True, 4096 * 8, 4096 * 8, 8, 8)
         q, k, v = self._qkv(t=256, d=16)
         out = flash_attention(q, k, v, causal=True, block_q=16,
                               block_k=16, interpret=True)

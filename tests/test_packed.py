@@ -179,3 +179,68 @@ def test_packed_grad_accum_weights_by_valid_count(tmp_path):
     assert abs(m1["loss"] - m2["loss"]) < 1e-4
     assert m1["count"] == m2["count"]
     np.testing.assert_allclose(p1, p2, rtol=2e-4, atol=2e-5)
+
+
+def test_packed_grad_accum_moe_aux_equal_weighting():
+    """Packed + MoE + grad_accum>1: the CE gradient is normalized by
+    the GLOBAL valid-target count, but the count-independent MoE aux
+    load-balance loss must get EQUAL (1/accum) microbatch weighting.
+    The pre-fix scheme scaled whole microbatch grads by their counts,
+    biasing the aux term toward fuller microbatches. Verified against
+    a hand-computed gradient with the correct per-term weighting."""
+    import optax
+
+    from tpunet.train.state import TrainState
+    from tpunet.train.steps import (_packed_target_weights,
+                                    make_lm_train_step)
+
+    cfg = dataclasses.replace(LM_CFG, moe_experts=2, moe_every=1,
+                              moe_aux_weight=0.1)
+    model = create_model(cfg)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=16)
+    params = variables["params"]
+
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(1, 256, (4, 16)), jnp.int32)
+    # wildly uneven valid counts: rows 1 and 3 are mostly padding, so
+    # the two strided microbatches (rows 0,2 vs rows 1,3) differ a lot
+    segs = np.ones((4, 16), np.int64)
+    segs[1, 4:] = 0
+    segs[3, 2:] = 0
+    segs = jnp.asarray(segs, jnp.int32)
+
+    total = jnp.maximum(jnp.sum(_packed_target_weights(segs)), 1.0)
+
+    def micro_terms(params, mx, ms):
+        logits, mut = model.apply(
+            {"params": params, "batch_stats": {}}, mx, train=True,
+            rngs={"dropout": jax.random.PRNGKey(0)},
+            mutable=["batch_stats", "losses"], segment_ids=ms)
+        lg, tgt = logits[:, :-1], mx[:, 1:]
+        ce = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
+        wt = _packed_target_weights(ms)
+        aux = 0.1 * sum(jax.tree_util.tree_leaves(mut["losses"]))
+        return jnp.sum(ce * wt), aux
+
+    def ref_loss(params):
+        out = 0.0
+        for i in range(2):          # strided split, as the step does
+            ce_sum, aux = micro_terms(params, toks[i::2], segs[i::2])
+            out = out + ce_sum / total + aux / 2.0
+        return out
+
+    expected = jax.grad(ref_loss)(params)
+
+    step = make_lm_train_step(
+        OptimConfig(learning_rate=1.0, grad_accum=2), cfg,
+        packed=True)
+    state = TrainState.create(apply_fn=model.apply, params=params,
+                              tx=optax.sgd(1.0), batch_stats={})
+    new_state, _ = jax.jit(step)(state, toks, segs,
+                                 jax.random.PRNGKey(0))
+    got = jax.tree_util.tree_map(lambda p, n: p - n, params,
+                                 new_state.params)
+    for e, g in zip(jax.tree_util.tree_leaves(expected),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                   rtol=2e-4, atol=1e-6)

@@ -209,8 +209,10 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
     if mesh is not None:
         stages = mesh.shape.get("pipe", 1)
         if stages > 1 and cfg.vit_depth % stages:
-            raise ValueError(f"vit_depth {cfg.vit_depth} not divisible "
-                             f"by {stages} pipeline stages")
+            raise ValueError(
+                f"--vit-depth {cfg.vit_depth} (the transformer depth "
+                f"flag — for lm_pp it is the LM's layer count) is not "
+                f"divisible by {stages} pipeline stages")
     return PipelinedLM(
         vocab_size=cfg.vocab_size,
         hidden=cfg.vit_hidden,

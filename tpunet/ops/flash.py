@@ -92,8 +92,17 @@ def _use_tri(causal, tq, tk, bq, bk) -> bool:
     """Triangular-grid eligibility: causal SELF-attention with square
     blocks — every diagonal block is then partially valid and every
     sub-diagonal block fully valid, so the lower triangle enumerates
-    exactly the needed (qi, ki) pairs."""
-    return causal and tq == tk and bq == bk
+    exactly the needed (qi, ki) pairs. The sqrt inversion in
+    _tri_qi_ki runs in float32: its ~2^-24 relative error keeps the
+    qi estimate within reach of the ±1 integer correction only while
+    the triangle size stays under 2**23 (verified exhaustively at
+    nq=4095); beyond that (tiny blocks on a very long sequence) fall
+    back to the rectangular grid rather than risk silently enumerating
+    wrong pairs."""
+    if not (causal and tq == tk and bq == bk):
+        return False
+    nq = -(-tq // bq)
+    return nq * (nq + 1) // 2 < 2 ** 23
 
 
 def _seg_mask(qseg_ref, kseg_ref):

@@ -46,7 +46,7 @@ def _with_aux(loss, mutated, aux_weight: float):
 
 def _steps_from_micro(micro: Callable, accum: int, mesh,
                       gather_params=None, ema_decay: float = 0.0,
-                      weight_by_count: bool = False) -> Callable:
+                      count_fn: Optional[Callable] = None) -> Callable:
     """Lift micro(params, batch_stats, apply_fn, x, y, rng) ->
     (grads, new_stats, metrics) into train_step(state, x, y, rng).
 
@@ -55,11 +55,15 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
     scanned *in time* — gradients averaged (mean of equal-sized means ==
     the full-batch mean), BatchNorm stats threaded through microbatches
     (torch semantics: stats update every forward), ONE optimizer update.
-    ``weight_by_count`` (packed sequences): microbatch example counts
-    are UNEQUAL (valid-target counts vary with packing), so each
-    microbatch's gradient is weighted by its metrics count and the sum
-    divided by the total — restoring the full-batch mean the equal
-    average would otherwise break.
+    ``count_fn`` (packed sequences): microbatch example counts are
+    UNEQUAL (valid-target counts vary with packing), so the GLOBAL
+    valid-target count ``count_fn(y)`` is computed up front and passed
+    to the micro as ``grad_norm=(total, accum)`` — the micro normalizes
+    its CE gradient by the global count (sum of microbatch grads then
+    IS the full-batch mean) and any count-independent terms (MoE aux
+    loss) by 1/accum (equal weighting).  Scaling whole microbatch
+    gradients by their counts instead would bias count-independent
+    terms toward fuller microbatches.
     Activation memory drops by ~1/accum; the XLA program stays static.
     The split is STRIDED (microbatch i = rows i, i+accum, ...): under
     the P('data') batch layout a contiguous split would move most rows
@@ -114,15 +118,18 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
                 a, NamedSharding(mesh, P(None, "data")))
             xs, ys = sh(xs), sh(ys)
         rngs = jax.random.split(rng, accum)
+        total = count_fn(y) if count_fn is not None else None
 
         def body(carry, inp):
             stats, gsum, msum = carry
             mx, my, mr = inp
-            grads, stats, m = micro(params, stats, state.apply_fn,
-                                    mx, my, mr)
-            if weight_by_count:
-                grads = jax.tree_util.tree_map(
-                    lambda g: g * m["count"], grads)
+            if count_fn is not None:
+                grads, stats, m = micro(params, stats, state.apply_fn,
+                                        mx, my, mr,
+                                        grad_norm=(total, accum))
+            else:
+                grads, stats, m = micro(params, stats, state.apply_fn,
+                                        mx, my, mr)
             gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
             return (stats, gsum, M.accumulate(msum, m)), None
 
@@ -130,9 +137,10 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
         (stats, gsum, msum), _ = jax.lax.scan(
             body, (state.batch_stats, gzero, M.zeros_metrics()),
             (xs, ys, rngs))
-        denom = (jnp.maximum(msum["count"], 1.0) if weight_by_count
-                 else accum)
-        grads = jax.tree_util.tree_map(lambda g: g / denom, gsum)
+        if count_fn is not None:
+            grads = gsum        # micro already normalized globally
+        else:
+            grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
         return finish(state, grads, stats), msum
 
     return train_step
@@ -221,7 +229,8 @@ def make_lm_train_step(optim_cfg: OptimConfig,
     aux_weight = model_cfg.moe_aux_weight
     smoothing = optim_cfg.label_smoothing
 
-    def micro(params, batch_stats, apply_fn, tokens, labels, rng):
+    def micro(params, batch_stats, apply_fn, tokens, labels, rng,
+              grad_norm=None):
         segs = labels if packed else None
 
         def loss_fn(params):
@@ -233,16 +242,31 @@ def make_lm_train_step(optim_cfg: OptimConfig,
                 mutable=["batch_stats", "losses"], **kwargs)
             lg, tgt = logits[:, :-1], tokens[:, 1:]
             ce = _ce_loss(lg, tgt, smoothing)
+            aux_terms = jax.tree_util.tree_leaves(
+                mutated.get("losses", {}))
+            aux = (aux_weight * sum(aux_terms)
+                   if aux_terms and aux_weight > 0 else 0.0)
             if packed:
                 wt = _packed_target_weights(segs)
+                ce_sum = jnp.sum(ce * wt)
                 n_valid = jnp.maximum(jnp.sum(wt), 1.0)
-                ce_mean = jnp.sum(ce * wt) / n_valid
+                report = ce_sum / n_valid + aux
+                if grad_norm is None:
+                    loss = report
+                else:
+                    # Grad-accum: CE over the GLOBAL valid-target count
+                    # and the count-independent aux term over 1/accum,
+                    # so plain summation of microbatch grads restores
+                    # the full-batch CE mean + equal-weighted aux mean
+                    # (see _steps_from_micro's count_fn contract).
+                    total, accum = grad_norm
+                    loss = ce_sum / total + aux / accum
             else:
-                ce_mean = ce.mean()
-            loss = _with_aux(ce_mean, mutated, aux_weight)
-            return loss, (lg, tgt, mutated.get("batch_stats", {}))
+                loss = report = ce.mean() + aux
+            return loss, (lg, tgt, mutated.get("batch_stats", {}),
+                          report)
 
-        (loss, (lg, tgt, new_stats)), grads = jax.value_and_grad(
+        (_, (lg, tgt, new_stats, report)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         hit = (jnp.argmax(lg, -1) == tgt).astype(jnp.float32)
         if packed:
@@ -252,12 +276,15 @@ def make_lm_train_step(optim_cfg: OptimConfig,
         else:
             n = tgt.size
             correct = jnp.sum(hit)
-        return grads, new_stats, M.from_batch(loss * n, correct, n)
+        return grads, new_stats, M.from_batch(report * n, correct, n)
+
+    def packed_count(y):
+        return jnp.maximum(jnp.sum(_packed_target_weights(y)), 1.0)
 
     return _steps_from_micro(micro, max(1, optim_cfg.grad_accum), mesh,
                              gather_params=gather_params,
                              ema_decay=optim_cfg.ema_decay,
-                             weight_by_count=packed)
+                             count_fn=packed_count if packed else None)
 
 
 def make_lm_eval_step(gather_params=None, packed: bool = False) -> Callable:
