@@ -1,0 +1,264 @@
+"""1F1B pipeline schedule (tpunet/parallel/pp.py onef1b).
+
+Three layers of evidence, matching the executor's claims:
+
+1. Schedule-table properties (host-side onef1b_schedule, the same
+   closed form the device scan uses): every (microbatch, stage) pair
+   gets exactly one F and one B tick, dependencies are satisfied, at
+   most one op per stage per tick, the last stage runs one-forward-
+   one-backward interleaved, and the ring-buffer slot assignment never
+   overwrites a live residual.
+2. Gradient parity with the GPipe executor on the 8-device CPU mesh
+   (pipe=2 and pipe=4, with and without dropout): the manual VJP must
+   be grad-for-grad identical to AD through the GPipe scan.
+3. Peak-memory: XLA's compiled memory analysis shows the 1f1b backward
+   allocating less temp memory than GPipe-AD's stacked residuals at
+   pipe>=2 with many microbatches.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpunet.config import ModelConfig
+from tpunet.models import create_model, init_variables
+from tpunet.parallel.pp import gpipe, onef1b, onef1b_schedule
+
+
+# ---------------------------------------------------------------------------
+# 1. Schedule-table properties
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("S,M", [(2, 1), (2, 3), (4, 2), (4, 8), (8, 4)])
+def test_schedule_table_properties(S, M):
+    table = onef1b_schedule(S, M)
+    assert len(table) == 2 * (M + S - 1)
+    f_tick, b_tick = {}, {}
+    for t, row in enumerate(table):
+        assert len(row) == S
+        for s, op in enumerate(row):
+            if op is None:
+                continue
+            kind, m = op
+            assert 0 <= m < M
+            key = (m, s)
+            if kind == "F":
+                assert key not in f_tick, f"duplicate F {key}"
+                f_tick[key] = t
+            else:
+                assert key not in b_tick, f"duplicate B {key}"
+                b_tick[key] = t
+    assert len(f_tick) == len(b_tick) == M * S
+
+    for m in range(M):
+        for s in range(S):
+            # forward dependency: stage s after stage s-1
+            if s > 0:
+                assert f_tick[(m, s)] > f_tick[(m, s - 1)]
+            # backward dependency: stage s after stage s+1
+            if s < S - 1:
+                assert b_tick[(m, s)] > b_tick[(m, s + 1)]
+            # backward only after the microbatch reached the last stage
+            assert b_tick[(m, s)] > f_tick[(m, S - 1)]
+
+
+@pytest.mark.parametrize("S,M", [(2, 4), (4, 8)])
+def test_schedule_interleaves_fwd_and_bwd(S, M):
+    """The defining 1F1B property: backwards START before forwards
+    FINISH (GPipe-AD runs all forwards, then all backwards). On the
+    last stage the steady state is strictly F(m), B(m), F(m+1), ..."""
+    table = onef1b_schedule(S, M)
+    last = [row[S - 1] for row in table if row[S - 1] is not None]
+    expect = []
+    for m in range(M):
+        expect += [("F", m), ("B", m)]
+    assert last == expect
+    # globally: the first backward precedes the last forward
+    first_b = min(t for t, row in enumerate(table)
+                  for op in row if op and op[0] == "B")
+    last_f = max(t for t, row in enumerate(table)
+                 for op in row if op and op[0] == "F")
+    assert first_b < last_f
+
+
+@pytest.mark.parametrize("S,M", [(2, 3), (4, 8), (8, 4), (4, 2)])
+def test_ring_buffer_never_overwrites_live_residual(S, M):
+    """Replay the schedule against a ring buffer of min(S, M) slots
+    (slot = m % n_buf, as the executor indexes): a forward's write must
+    never clobber a residual whose backward hasn't run yet."""
+    n_buf = min(S, M)
+    table = onef1b_schedule(S, M)
+    live = [dict() for _ in range(S)]          # stage -> slot -> m
+    for row in table:
+        for s, op in enumerate(row):
+            if op is None:
+                continue
+            kind, m = op
+            slot = m % n_buf
+            if kind == "F":
+                assert slot not in live[s], (
+                    f"stage {s}: F({m}) overwrites live residual of "
+                    f"microbatch {live[s].get(slot)}")
+                live[s][slot] = m
+            else:
+                assert live[s].get(slot) == m
+                del live[s][slot]
+    assert all(not d for d in live)
+
+
+# ---------------------------------------------------------------------------
+# 2. Gradient parity vs GPipe on the CPU mesh
+# ---------------------------------------------------------------------------
+
+def _toy_stage(params, x, key=None):
+    """A 2-param nonlinear stage; scans over its stacked leading dim
+    like the real models do, with per-layer dropout when keyed."""
+    def body(carry, inp):
+        (w, b), i = inp
+        h = jnp.tanh(carry @ w + b)
+        if key is not None:
+            k = jax.random.fold_in(key, i)
+            keep = jax.random.bernoulli(k, 0.9, h.shape)
+            h = jnp.where(keep, h / 0.9, 0.0)
+        return h + carry, None
+    idx = jnp.arange(params[0].shape[0])
+    out, _ = jax.lax.scan(body, x, (params, idx))
+    return out
+
+
+def _mesh(pipe, data=2):
+    devs = np.array(jax.devices()[:data * pipe]).reshape(data, pipe)
+    return Mesh(devs, ("data", "pipe"))
+
+
+@pytest.mark.parametrize("pipe,n_micro,keyed", [
+    (2, 4, False), (4, 4, False), (2, 2, False), (2, 4, True),
+    (4, 2, True),
+])
+def test_grad_parity_vs_gpipe(pipe, n_micro, keyed):
+    mesh = _mesh(pipe)
+    rng = np.random.default_rng(0)
+    L, C, B, T = 8, 16, 8, 4
+    params = (jnp.asarray(rng.normal(0, 0.3, (L, C, C)), jnp.float32),
+              jnp.asarray(rng.normal(0, 0.1, (L, C)), jnp.float32))
+    x = jnp.asarray(rng.normal(0, 1, (B, T, C)), jnp.float32)
+    key = jax.random.PRNGKey(7) if keyed else None
+    dy = jnp.asarray(rng.normal(0, 1, (B, T, C)), jnp.float32)
+
+    def loss(executor, params, x):
+        y = executor(_toy_stage, params, x, mesh=mesh,
+                     n_micro=n_micro, key=key)
+        return jnp.sum(y * dy)       # arbitrary cotangent
+
+    with mesh:
+        ref_v, ref_g = jax.value_and_grad(
+            functools.partial(loss, gpipe), argnums=(0, 1))(params, x)
+        new_v, new_g = jax.value_and_grad(
+            functools.partial(loss, onef1b), argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(np.asarray(new_v), np.asarray(ref_v),
+                               rtol=1e-5, atol=1e-5)
+    for r, n in zip(jax.tree_util.tree_leaves(ref_g),
+                    jax.tree_util.tree_leaves(new_g)):
+        np.testing.assert_allclose(np.asarray(n), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipe1_fallback_matches_plain_apply():
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1),
+                ("data", "pipe"))
+    rng = np.random.default_rng(1)
+    params = (jnp.asarray(rng.normal(0, 0.3, (4, 8, 8)), jnp.float32),
+              jnp.zeros((4, 8), jnp.float32))
+    x = jnp.asarray(rng.normal(0, 1, (4, 3, 8)), jnp.float32)
+    with mesh:
+        out = onef1b(_toy_stage, params, x, mesh=mesh, n_micro=2)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_toy_stage(params, x)),
+                               rtol=1e-6, atol=1e-6)
+
+
+LMPP_CFG = ModelConfig(name="lm_pp", vit_hidden=32, vit_depth=4,
+                       vit_heads=2, dropout_rate=0.0, dtype="float32",
+                       vocab_size=64, max_seq_len=32, pp_microbatches=2)
+
+
+@pytest.mark.parametrize("dropout", [0.0, 0.1])
+def test_lm_pp_model_grads_match_across_schedules(dropout):
+    """Full-model parity: PipelinedLM grads under 1f1b == gpipe on a
+    dp2 x pp2 mesh, including the embed/pos/LN params outside the
+    executor, with and without pipelined dropout."""
+    mesh = _mesh(2)
+    cfg = dataclasses.replace(LMPP_CFG, dropout_rate=dropout)
+    toks = jnp.asarray(
+        np.random.default_rng(3).integers(0, 64, (4, 16)), jnp.int32)
+
+    def grads(schedule):
+        c = dataclasses.replace(cfg, pp_schedule=schedule)
+        model = create_model(c, mesh=mesh)
+        variables = init_variables(model, jax.random.PRNGKey(0),
+                                   batch_size=4, seq_len=16)
+
+        def loss(params):
+            logits = model.apply(
+                {"params": params}, toks, train=True,
+                rngs={"dropout": jax.random.PRNGKey(11)})
+            return jnp.mean(
+                (logits - jnp.roll(logits, 1, axis=-1)) ** 2)
+
+        with mesh:
+            return variables, jax.grad(loss)(variables["params"])
+
+    v1, g1 = grads("gpipe")
+    v2, g2 = grads("1f1b")
+    # identical init (same seed/architecture) is a precondition
+    for a, b in zip(jax.tree_util.tree_leaves(v1),
+                    jax.tree_util.tree_leaves(v2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    flat1 = jax.tree_util.tree_leaves_with_path(g1)
+    flat2 = {jax.tree_util.keystr(p): l
+             for p, l in jax.tree_util.tree_leaves_with_path(g2)}
+    for p, r in flat1:
+        n = flat2[jax.tree_util.keystr(p)]
+        np.testing.assert_allclose(
+            np.asarray(n), np.asarray(r), rtol=2e-4, atol=1e-6,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(p)}")
+
+
+# ---------------------------------------------------------------------------
+# 3. Peak-memory: 1f1b's backward must beat GPipe-AD's stacked residuals
+# ---------------------------------------------------------------------------
+
+def test_1f1b_uses_less_temp_memory_than_gpipe():
+    """XLA memory analysis of the full value_and_grad program at
+    pipe=2 with MANY microbatches (where GPipe-AD's O(M) stacked
+    per-tick residuals dominate and 1f1b's O(min(S,M)) ring should
+    win). Compares temp allocation, the bucket holding scan residuals."""
+    mesh = _mesh(2)
+    rng = np.random.default_rng(0)
+    L, C, B, T, M = 8, 64, 32, 32, 16
+    params = (jnp.asarray(rng.normal(0, 0.3, (L, C, C)), jnp.float32),
+              jnp.zeros((L, C), jnp.float32))
+    x = jnp.asarray(rng.normal(0, 1, (B, T, C)), jnp.float32)
+
+    def compile_for(executor):
+        def loss(p, xx):
+            y = executor(_toy_stage, p, xx, mesh=mesh, n_micro=M)
+            return jnp.sum(y ** 2)
+        with mesh:
+            return jax.jit(jax.value_and_grad(loss)).lower(params, x
+                                                           ).compile()
+
+    mem_gpipe = compile_for(gpipe).memory_analysis()
+    mem_1f1b = compile_for(onef1b).memory_analysis()
+    if mem_gpipe is None or mem_1f1b is None:
+        pytest.skip("memory_analysis unavailable on this backend")
+    t_gpipe = mem_gpipe.temp_size_in_bytes
+    t_1f1b = mem_1f1b.temp_size_in_bytes
+    # The documented claim: strictly less temp memory, by a real margin.
+    assert t_1f1b < 0.7 * t_gpipe, (
+        f"1f1b temp {t_1f1b} not < 70% of gpipe temp {t_gpipe}")

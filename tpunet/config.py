@@ -134,6 +134,11 @@ class ModelConfig:
     # Pipeline parallelism (model name "vit_pp"): GPipe microbatches per
     # step; stages = the mesh 'pipe' axis size.
     pp_microbatches: int = 4
+    # Pipeline schedule: "gpipe" (AD-emitted backward: all forwards,
+    # then all backwards) or "1f1b" (manual-VJP backward interleaving
+    # fwd/bwd per microbatch — O(min(S, M)) live stage inputs instead
+    # of O(M) stacked per-layer internals; same grads, parity-tested).
+    pp_schedule: str = "gpipe"
     # LM family (model name "lm"): vocab and the learned-position table
     # size (max trainable sequence length).
     vocab_size: int = 256
@@ -340,6 +345,11 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="vocab for the lm model + synthetic_lm data")
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatches per step (vit_pp)")
+    p.add_argument("--pp-schedule", default=None,
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule: gpipe (AD backward) or "
+                        "1f1b (manual-VJP interleaved backward, "
+                        "bounded activation memory)")
     p.add_argument("--attention", default=None,
                    choices=["auto", "dense", "blockwise", "flash",
                             "ring", "ulysses"],
@@ -477,7 +487,7 @@ def config_from_args(argv=None) -> TrainConfig:
     for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads",
                  "moe_experts", "moe_top_k", "moe_every",
                  "moe_capacity_factor", "moe_aux_weight",
-                 "pp_microbatches"):
+                 "pp_microbatches", "pp_schedule"):
         val = getattr(args, name)
         if val is not None:
             model = dataclasses.replace(model, **{name: val})

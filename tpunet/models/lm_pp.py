@@ -40,15 +40,16 @@ meshes (unmeasurable on this 1-chip environment; the dp x pp dryrun
 leg validates the program, not its scaling). With the dense core this
 was 132k tok/s.
 
-Schedule note: the executor is plain GPipe (bubble (S-1)/(M+S-1)).
-A hand-scheduled 1F1B would need manual VJP orchestration — JAX's
-reverse-mode AD through the scan+ppermute already EMITS the standard
-backward pipeline, but its schedule (all forwards, then all backwards)
-is fixed by AD; interleaving fwd/bwd per microbatch means writing the
-backward by hand. Deliberately not done: the memory win 1F1B buys is
-covered more cheaply here by per-stage activation bounding (the scan
-carries one microbatch's activations per stage) and --remat on the
-other families.
+Schedule note: two executors (``--pp-schedule``). "gpipe" (default)
+lets reverse-mode AD through the scan+ppermute emit the standard
+backward pipeline (all forwards, then all backwards — its residuals
+stack every per-tick intermediate). "1f1b" is the hand-written VJP
+(tpunet/parallel/pp.py onef1b): the backward replays forwards and runs
+backwards interleaved per microbatch in 1F1B order, holding at most
+min(S, M) stage inputs live — the 1F1B activation bound — at the cost
+of one rematerialized stage forward per microbatch. Same grads
+(parity-tested), same bubble fraction; pick 1f1b when activation
+memory, not compute, is the binding constraint.
 """
 
 from __future__ import annotations
@@ -62,7 +63,7 @@ from flax import linen as nn
 from tpunet.config import ModelConfig
 from tpunet.models.vit_pp import (_dropout, _stacked_lecun_normal,
                                   block_apply, resolve_block_cores)
-from tpunet.parallel.pp import gpipe
+from tpunet.parallel.pp import gpipe, onef1b
 
 
 class PipelinedLM(nn.Module):
@@ -77,6 +78,7 @@ class PipelinedLM(nn.Module):
     n_micro: int = 4
     dropout_rate: float = 0.0
     attention: str = "dense"           # dense | flash | auto
+    schedule: str = "gpipe"            # gpipe | 1f1b (pp.py executors)
     mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -155,8 +157,9 @@ class PipelinedLM(nn.Module):
             return out
 
         if pipelined:
-            x = gpipe(stage_apply, blocks, x, mesh=self.mesh,
-                      n_micro=self.n_micro, key=key)
+            executor = onef1b if self.schedule == "1f1b" else gpipe
+            x = executor(stage_apply, blocks, x, mesh=self.mesh,
+                         n_micro=self.n_micro, key=key)
         else:
             x = (stage_apply(blocks, x) if key is None
                  else stage_apply(blocks, x, key))
@@ -206,6 +209,9 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
         raise ValueError("lm_pp does not support --remat (the pipeline "
                          "scan already bounds activation memory per "
                          "stage)")
+    if cfg.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}; "
+                         "expected gpipe|1f1b")
     if mesh is not None:
         stages = mesh.shape.get("pipe", 1)
         if stages > 1 and cfg.vit_depth % stages:
@@ -223,6 +229,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
         n_micro=cfg.pp_microbatches,
         dropout_rate=cfg.dropout_rate,
         attention=cfg.attention,
+        schedule=cfg.pp_schedule,
         mesh=mesh,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),

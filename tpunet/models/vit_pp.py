@@ -35,7 +35,7 @@ from flax import linen as nn
 from tpunet.config import ModelConfig
 from tpunet.ops import dense_attention
 from tpunet.ops.flash import flash_attention, local_flash_attention
-from tpunet.parallel.pp import gpipe
+from tpunet.parallel.pp import gpipe, onef1b
 
 
 def resolve_block_cores(attention: str):
@@ -123,6 +123,7 @@ class PipelinedViT(nn.Module):
     n_micro: int = 4
     dropout_rate: float = 0.0
     attention: str = "dense"           # dense | flash | auto
+    schedule: str = "gpipe"            # gpipe | 1f1b (pp.py executors)
     mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -201,8 +202,9 @@ class PipelinedViT(nn.Module):
             return out
 
         if pipelined:
-            x = gpipe(stage_apply, blocks, x, mesh=self.mesh,
-                      n_micro=self.n_micro, key=key)
+            executor = onef1b if self.schedule == "1f1b" else gpipe
+            x = executor(stage_apply, blocks, x, mesh=self.mesh,
+                         n_micro=self.n_micro, key=key)
         else:
             x = (stage_apply(blocks, x) if key is None
                  else stage_apply(blocks, x, key))
@@ -226,6 +228,9 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
             "pipeline's shard_map")
     if cfg.moe_experts > 0:
         raise ValueError("vit_pp does not support MoE blocks")
+    if cfg.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}; "
+                         "expected gpipe|1f1b")
     if mesh is not None:
         stages = mesh.shape.get("pipe", 1)
         if stages > 1 and cfg.vit_depth % stages:
@@ -241,6 +246,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
         n_micro=cfg.pp_microbatches,
         dropout_rate=cfg.dropout_rate,
         attention=cfg.attention,
+        schedule=cfg.pp_schedule,
         mesh=mesh,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),

@@ -27,6 +27,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -52,12 +53,7 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
         return (stage_apply(stacked_params, x) if key is None
                 else stage_apply(stacked_params, x, key))
 
-    for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
-        if leaf.shape[0] % n_stages:
-            raise ValueError(
-                f"stacked param {jax.tree_util.keystr(path)} has leading "
-                f"(layer) dim {leaf.shape[0]} not divisible by "
-                f"{n_stages} pipeline stages")
+    _check_stacked(stacked_params, n_stages)
 
     p_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     x_spec = P(data_axis, None, None)
@@ -136,3 +132,244 @@ def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
         jnp.where(s == n_stages - 1, outbuf, jnp.zeros_like(outbuf)),
         axis_name)
     return outbuf.reshape(bl, t, c)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: manual-VJP executor with an interleaved fwd/bwd backward schedule.
+# ---------------------------------------------------------------------------
+
+def _check_stacked(stacked_params, n_stages: int) -> None:
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
+        if leaf.shape[0] % n_stages:
+            raise ValueError(
+                f"stacked param {jax.tree_util.keystr(path)} has leading "
+                f"(layer) dim {leaf.shape[0]} not divisible by "
+                f"{n_stages} pipeline stages")
+
+
+def onef1b_schedule(n_stages: int, n_micro: int) -> list:
+    """The 1F1B tick table, host-side, for tests and inspection:
+    ``table[t][s]`` is ``("F", m)``, ``("B", m)``, or ``None`` (idle).
+
+    Closed form (the device-side scan uses the same integer math):
+    forward of microbatch m runs at stage s on tick ``s + 2m``;
+    backward on tick ``2S - 1 - s + 2m``. F-ticks at stage s all share
+    parity ``s % 2`` and B-ticks parity ``(s+1) % 2``, so the two
+    streams interleave without collision; the last stage runs
+    ``F(m), B(m), F(m+1), B(m+1), ...`` — one-forward-one-backward.
+    Total ticks ``2(M + S - 1)``, the same bubble fraction as GPipe
+    (non-interleaved 1F1B improves memory, not bubble).
+    """
+    S, M = n_stages, n_micro
+    total = 2 * (M + S - 1)
+    table = [[None] * S for _ in range(total)]
+    for s in range(S):
+        for m in range(M):
+            table[s + 2 * m][s] = ("F", m)
+            table[2 * S - 1 - s + 2 * m][s] = ("B", m)
+    return table
+
+
+def onef1b(stage_apply: Callable, stacked_params, x, *,
+           mesh: Mesh, n_micro: int, axis_name: str = "pipe",
+           data_axis: str = "data", key=None):
+    """GPipe-compatible pipeline executor with a manual VJP whose
+    backward runs the 1F1B schedule.
+
+    Same contract as :func:`gpipe` (identical primal math, identical
+    dropout key folding, so the two are grad-for-grad interchangeable —
+    the parity tests assert it). The difference is memory: reverse-mode
+    AD through the GPipe scan stacks EVERY per-tick intermediate (each
+    stage's per-layer internals x ``M + S - 1`` ticks) as scan
+    residuals, O(M) microbatches live at once. Here the forward is
+    wrapped in ``jax.custom_vjp`` and saves only ``(params, x, key)``;
+    the hand-written backward replays forwards and runs backwards in
+    ONE combined scan in 1F1B order — forward of microbatch m at stage
+    s on tick ``s + 2m``, backward on tick ``2S - 1 - s + 2m``
+    (:func:`onef1b_schedule`) — holding a ring buffer of at most
+    ``min(S, M)`` stage-input activations per device, the 1F1B
+    in-flight bound. Per-tick vjp internals are transient (freed every
+    tick), never stacked.
+
+    Cost: one extra stage forward per microbatch (the replay), the
+    standard price of rematerialized pipeline backward — the loss and
+    its cotangent live OUTSIDE the executor (final LN/logits/CE run on
+    the full output), so true no-remat 1F1B (loss inside the last
+    stage) is not expressible at this interface. Collectives are
+    hoisted out of the fwd/bwd branch (``lax.cond`` branches must not
+    diverge on collectives): every tick runs exactly one forward-shift
+    and one reverse-shift ``ppermute``, with zeros masked in for
+    whichever stream a stage isn't driving. Double differentiation is
+    not supported (custom_vjp).
+    """
+    n_stages = mesh.shape[axis_name]
+    if n_stages == 1:
+        return (stage_apply(stacked_params, x) if key is None
+                else stage_apply(stacked_params, x, key))
+    _check_stacked(stacked_params, n_stages)
+
+    p_specs = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                     stacked_params)
+    x_spec = P(data_axis, None, None)
+    keyed = key is not None
+    kk = key if keyed else jnp.zeros((2,), jnp.uint32)
+
+    def fwd_program(params, xx, k):
+        if keyed:
+            body = functools.partial(_gpipe_body_keyed, stage_apply,
+                                     n_micro=n_micro, axis_name=axis_name)
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(p_specs, x_spec, P()),
+                out_specs=x_spec, check_vma=False)(params, xx, k)
+        body = functools.partial(_gpipe_body, stage_apply,
+                                 n_micro=n_micro, axis_name=axis_name)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(p_specs, x_spec),
+            out_specs=x_spec, check_vma=False)(params, xx)
+
+    def bwd_program(params, xx, k, dy):
+        body = functools.partial(_onef1b_bwd_body, stage_apply,
+                                 n_micro=n_micro, axis_name=axis_name,
+                                 data_axis=data_axis,
+                                 n_stages=n_stages, keyed=keyed)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(p_specs, x_spec, P(), x_spec),
+            out_specs=(p_specs, x_spec), check_vma=False)(
+                params, xx, k, dy)
+
+    @jax.custom_vjp
+    def run(params, xx, k):
+        return fwd_program(params, xx, k)
+
+    def run_fwd(params, xx, k):
+        return fwd_program(params, xx, k), (params, xx, k)
+
+    def run_bwd(res, dy):
+        params, xx, k = res
+        dparams, dx = bwd_program(params, xx, k, dy)
+        # PRNG keys are integer-typed: their cotangent type is float0.
+        dk = np.zeros(np.shape(k), dtype=jax.dtypes.float0)
+        return dparams, dx, dk
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked_params, x, kk)
+
+
+def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
+                     n_micro, axis_name, data_axis, n_stages, keyed):
+    """Device-local 1F1B backward: one scan over 2(M+S-1) ticks.
+
+    Carry: (act_in, cot_in, resid ring, dparam accumulator fp32,
+    dx buffer). Each tick a stage is an F-tick (replay one stage
+    forward, save its input to the ring, ship the activation down),
+    a B-tick (vjp the saved input against the incoming cotangent,
+    accumulate dparams, ship the input-cotangent up), or idle
+    (masked). F/B tick parities differ per stage (onef1b_schedule), so
+    one ``lax.cond`` picks the work; both ppermutes run unconditionally
+    with masked zeros.
+    """
+    s = jax.lax.axis_index(axis_name)
+    S, M = n_stages, n_micro
+    bl, t, c = xl.shape
+    if bl % M:
+        raise ValueError(f"local batch {bl} not divisible by "
+                         f"{M} microbatches")
+    mb = bl // M
+    xm = xl.reshape(M, mb, t, c)
+    dym = dyl.reshape(M, mb, t, c)
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    rev_perm = [(i + 1, i) for i in range(S - 1)]
+    n_buf = min(S, M)   # 1F1B in-flight bound (residency at stage s
+    #                     is S - s microbatches; see overwrite proof
+    #                     in tests/test_pp_1f1b.py)
+
+    def apply_f(params, inp, m):
+        if keyed:
+            # EXACTLY _gpipe_body_keyed's folding — fwd tick = m + s —
+            # so replayed dropout masks match the primal bit-for-bit.
+            k = jax.random.fold_in(jax.random.fold_in(key, m + s), s)
+            return stage_apply(params, inp, k)
+        return stage_apply(params, inp)
+
+    def tick(carry, t_):
+        act_in, cot_in, resid, dpsum, dxbuf = carry
+        df = t_ - s
+        m_f = df // 2
+        f_valid = (df >= 0) & (df % 2 == 0) & (m_f < M)
+        db = t_ - (2 * S - 1 - s)
+        m_b = db // 2
+        b_valid = (db >= 0) & (db % 2 == 0) & (m_b < M)
+        m_fc = jnp.clip(m_f, 0, M - 1)
+        m_bc = jnp.clip(m_b, 0, M - 1)
+
+        f_inp = jnp.where(
+            s == 0,
+            jax.lax.dynamic_index_in_dim(xm, m_fc, 0, keepdims=False),
+            act_in)
+        g_in = jnp.where(
+            s == S - 1,
+            jax.lax.dynamic_index_in_dim(dym, m_bc, 0, keepdims=False),
+            cot_in)
+        b_slot = m_bc % n_buf
+        b_inp = jax.lax.dynamic_index_in_dim(resid, b_slot, 0,
+                                             keepdims=False)
+
+        zero_dp = jax.tree_util.tree_map(jnp.zeros_like, local_params)
+
+        def do_f(_):
+            y = apply_f(local_params, f_inp, m_fc)
+            return y, jnp.zeros_like(f_inp), zero_dp
+
+        def do_b(_):
+            # Recompute this stage's forward and pull the cotangent
+            # back through it — idle ticks also land here on zeros,
+            # masked out below.
+            _, pull = jax.vjp(lambda p, xi: apply_f(p, xi, m_bc),
+                              local_params, b_inp)
+            dp, dx = pull(g_in)
+            return jnp.zeros_like(f_inp), dx, dp
+
+        y, dx, dp = jax.lax.cond(f_valid, do_f, do_b, None)
+        y = jnp.where(f_valid, y, jnp.zeros_like(y))
+        dx = jnp.where(b_valid, dx, jnp.zeros_like(dx))
+        dpsum = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(b_valid, g,
+                                           jnp.zeros_like(g)
+                                           ).astype(acc.dtype),
+            dpsum, dp)
+
+        f_slot = m_fc % n_buf
+        old = jax.lax.dynamic_index_in_dim(resid, f_slot, 0,
+                                           keepdims=False)
+        resid = jax.lax.dynamic_update_index_in_dim(
+            resid, jnp.where(f_valid, f_inp, old), f_slot, 0)
+        oldx = jax.lax.dynamic_index_in_dim(dxbuf, m_bc, 0,
+                                            keepdims=False)
+        dxbuf = jax.lax.dynamic_update_index_in_dim(
+            dxbuf, jnp.where(b_valid & (s == 0), dx, oldx), m_bc, 0)
+
+        act_next = jax.lax.ppermute(y, axis_name, fwd_perm)
+        cot_next = jax.lax.ppermute(dx, axis_name, rev_perm)
+        return (act_next, cot_next, resid, dpsum, dxbuf), None
+
+    carry0 = (
+        jnp.zeros((mb, t, c), xl.dtype),
+        jnp.zeros((mb, t, c), dyl.dtype),
+        jnp.zeros((n_buf, mb, t, c), xl.dtype),
+        jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), local_params),
+        jnp.zeros_like(dym),
+    )
+    (_, _, _, dpsum, dxbuf), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(2 * (M + S - 1)))
+    # Stage 0 holds the real input-cotangents; replicate like the
+    # forward's output buffer. dparams stay per-stage (out spec 'pipe')
+    # but each data shard only saw ITS microbatches — sum the partial
+    # param grads over 'data', the psum GPipe-AD's transpose inserts
+    # for the params' replicated-over-data in_spec.
+    dx = jax.lax.psum(
+        jnp.where(s == 0, dxbuf, jnp.zeros_like(dxbuf)), axis_name)
+    dparams = jax.tree_util.tree_map(
+        lambda acc, p: jax.lax.psum(acc, data_axis).astype(p.dtype),
+        dpsum, local_params)
+    return dparams, dx.reshape(bl, t, c)

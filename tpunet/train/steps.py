@@ -36,12 +36,18 @@ def _ce_loss(logits, targets, smoothing: float):
     return optax.softmax_cross_entropy_with_integer_labels(logits, targets)
 
 
-def _with_aux(loss, mutated, aux_weight: float):
-    """Add weighted MoE load-balance terms sown into 'losses'."""
+def _aux_term(mutated, aux_weight: float):
+    """Weighted sum of the MoE load-balance terms sown into 'losses'
+    (0.0 when absent/unweighted) — the ONE place the aux rule lives."""
     aux_terms = jax.tree_util.tree_leaves(mutated.get("losses", {}))
     if aux_terms and aux_weight > 0:
-        loss = loss + aux_weight * sum(aux_terms)
-    return loss
+        return aux_weight * sum(aux_terms)
+    return 0.0
+
+
+def _with_aux(loss, mutated, aux_weight: float):
+    """Add weighted MoE load-balance terms sown into 'losses'."""
+    return loss + _aux_term(mutated, aux_weight)
 
 
 def _steps_from_micro(micro: Callable, accum: int, mesh,
@@ -242,31 +248,34 @@ def make_lm_train_step(optim_cfg: OptimConfig,
                 mutable=["batch_stats", "losses"], **kwargs)
             lg, tgt = logits[:, :-1], tokens[:, 1:]
             ce = _ce_loss(lg, tgt, smoothing)
-            aux_terms = jax.tree_util.tree_leaves(
-                mutated.get("losses", {}))
-            aux = (aux_weight * sum(aux_terms)
-                   if aux_terms and aux_weight > 0 else 0.0)
+            aux = _aux_term(mutated, aux_weight)
             if packed:
                 wt = _packed_target_weights(segs)
                 ce_sum = jnp.sum(ce * wt)
                 n_valid = jnp.maximum(jnp.sum(wt), 1.0)
-                report = ce_sum / n_valid + aux
                 if grad_norm is None:
-                    loss = report
+                    loss = ce_sum / n_valid + aux
+                    loss_sum = ce_sum + aux * n_valid
                 else:
                     # Grad-accum: CE over the GLOBAL valid-target count
                     # and the count-independent aux term over 1/accum,
                     # so plain summation of microbatch grads restores
                     # the full-batch CE mean + equal-weighted aux mean
-                    # (see _steps_from_micro's count_fn contract).
+                    # (see _steps_from_micro's count_fn contract). The
+                    # METRIC weights aux the same way: summed loss_sums
+                    # divided by the total count give exactly
+                    # CE_global_mean + mean_i(aux_i) — the objective
+                    # being optimized, not a count-weighted variant.
                     total, accum = grad_norm
                     loss = ce_sum / total + aux / accum
+                    loss_sum = ce_sum + aux * total / accum
             else:
-                loss = report = ce.mean() + aux
+                loss = ce.mean() + aux
+                loss_sum = loss * tgt.size
             return loss, (lg, tgt, mutated.get("batch_stats", {}),
-                          report)
+                          loss_sum)
 
-        (_, (lg, tgt, new_stats, report)), grads = jax.value_and_grad(
+        (_, (lg, tgt, new_stats, loss_sum)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         hit = (jnp.argmax(lg, -1) == tgt).astype(jnp.float32)
         if packed:
@@ -276,7 +285,7 @@ def make_lm_train_step(optim_cfg: OptimConfig,
         else:
             n = tgt.size
             correct = jnp.sum(hit)
-        return grads, new_stats, M.from_batch(report * n, correct, n)
+        return grads, new_stats, M.from_batch(loss_sum, correct, n)
 
     def packed_count(y):
         return jnp.maximum(jnp.sum(_packed_target_weights(y)), 1.0)
