@@ -37,6 +37,33 @@ if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
+def analytic_train_flops(b: int, t: int, c: int, depth: int,
+                         mlp_ratio: float, vocab: int) -> float:
+    """Standard analytic model-FLOPs for one causal-LM train step
+    (PaLM-style MFU accounting: matmul FLOPs only, backward = 2x
+    forward, causal attention at half the full-score cost). Used for
+    MFU instead of XLA cost_analysis because the Pallas flash kernel
+    is a custom call whose FLOPs XLA does not count — and analytic
+    model-FLOPs is the honest MFU numerator anyway (rematerialized
+    recompute must not inflate utilization)."""
+    per_block = (8 + 4 * mlp_ratio) * b * t * c * c   # qkv+out+mlp
+    attn = 2 * b * t * t * c                          # scores+values, causal
+    head = 2 * b * t * c * vocab                      # tied logits
+    fwd = depth * (per_block + attn) + head
+    return 3.0 * fwd                                  # fwd + 2x bwd
+
+
+_PEAK_FLOPS = (       # bf16 peak per chip (same table as bench.py)
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v6", 918e12), ("trillium", 918e12), ("v4", 275e12), ("v3", 123e12),
+)
+
+
+def peak_flops_per_chip() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    return next((v for k, v in _PEAK_FLOPS if k in kind), 0.0)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--seq-len", type=int, default=2048)
@@ -45,6 +72,8 @@ def main() -> None:
     p.add_argument("--depth", type=int, default=4)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--attention-block", type=int, default=None,
+                   help="flash kernel block_q/block_k override")
     p.add_argument("--attention", nargs="+",
                    default=["dense", "blockwise", "flash"])
     p.add_argument("--model", choices=("lm", "lm_pp"), default="lm",
@@ -79,14 +108,20 @@ def main() -> None:
                                                         "auto"}:
         args.attention = ["auto"]      # pipelined blocks: dense/flash only
 
-    results = {}
+    results, mfus = {}, {}
+    flops_step = analytic_train_flops(args.batch, args.seq_len,
+                                      args.hidden, args.depth, 4.0,
+                                      args.vocab)
+    peak = peak_flops_per_chip()
     for attn in args.attention:
         mcfg = ModelConfig(
             name=args.model, vit_hidden=args.hidden,
             vit_depth=args.depth,
             vit_heads=args.heads, vocab_size=args.vocab,
             max_seq_len=args.seq_len, dropout_rate=0.0, attention=attn,
-            remat=args.remat and args.model == "lm")
+            remat=args.remat and args.model == "lm",
+            **({"attention_block": args.attention_block}
+               if args.attention_block else {}))
         model = create_model(mcfg)
         variables = init_variables(model, jax.random.PRNGKey(0),
                                    seq_len=args.seq_len)
@@ -115,8 +150,27 @@ def main() -> None:
             best = min(best, (time.perf_counter() - t0) / args.steps)
         tok_s = args.batch * args.seq_len / best
         results[attn] = round(tok_s, 1)
+        mfu = (flops_step / best / peak) if peak else None
+        if mfu is not None:
+            mfus[attn] = round(mfu, 4)
+        # Cross-check only: XLA's count misses Pallas custom-call FLOPs
+        # (flash) and counts remat recompute (remat), so the analytic
+        # number above is the MFU numerator.
+        xla_flops = 0.0
+        try:
+            ca = step.lower(state, toks, None,
+                            step_key(0, 0)).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            xla_flops = float(ca.get("flops", 0.0))
+        except Exception:
+            pass
         print(f"# {attn}: {best * 1e3:.1f} ms/step, "
-              f"{tok_s:,.0f} tok/s", file=sys.stderr, flush=True)
+              f"{tok_s:,.0f} tok/s"
+              + (f", MFU {mfu:.3f} (analytic {flops_step / 1e9:.1f} "
+                 f"GFLOP/step; xla counts {xla_flops / 1e9:.1f})"
+                 if mfu is not None else ""),
+              file=sys.stderr, flush=True)
 
     print(json.dumps({
         "metric": "lm_train_tokens_per_sec",
@@ -124,9 +178,13 @@ def main() -> None:
                    "seq_len": args.seq_len,
                    "hidden": args.hidden, "depth": args.depth,
                    "heads": args.heads, "remat": args.remat,
+                   "attention_block": args.attention_block,
                    "platform": jax.devices()[0].platform},
         "value": results,
         "unit": "tok/s",
+        "analytic_flops_per_step": flops_step,
+        "peak_flops_per_chip": peak,
+        "mfu": mfus,
     }))
 
 

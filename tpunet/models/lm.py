@@ -139,7 +139,7 @@ def filter_logits(lg, *, top_k: int = 0, top_p: float = 0.0):
 def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
              *, temperature: float = 0.0, top_k: int = 0,
              top_p: float = 0.0, rng=None,
-             use_cache: bool = True):
+             use_cache: bool = True, mesh=None):
     """Greedy (or sampled) autoregressive generation from ``prompt``
     [B, T0] int32. ``temperature`` 0 = greedy; > 0 samples
     softmax(logits/T), optionally truncated to the ``top_k``
@@ -154,6 +154,14 @@ def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
     tokens with per-step expert capacity, so when experts overflow, the
     drop set can differ from a full-prefix forward pass (exact equality
     holds whenever nothing is dropped, e.g. small batches).
+
+    ``mesh`` (tensor-parallel serving): when the caller placed
+    ``variables`` with TP shardings (tpunet/infer/generate.py load_lm
+    --mesh-model), pass the mesh so the KV cache is created sharded to
+    match — heads over 'model', the layout the attention's head-sharded
+    Q/K/V writes produce. Without it GSPMD would reshard the cache
+    every step. Same tokens out: sharding never changes the math
+    (exactness test vs the unsharded path).
 
     ``use_cache=False`` falls back to full-prefix recompute: dense
     models reuse a fixed-size buffer (one compile; causality makes the
@@ -177,8 +185,20 @@ def generate(model: TransformerLM, variables: dict, prompt, n_new: int,
             lambda: model.init(jax.random.PRNGKey(0),
                                jnp.zeros((b, total), jnp.int32),
                                decode=True))
-        cache = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+        def cache_zeros(s):
+            if mesh is not None:
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as P)
+                tp = mesh.shape.get("model", 1)
+                spec = (P(None, None, "model", None)
+                        if (s.ndim == 4 and tp > 1
+                            and s.shape[2] % tp == 0) else P())
+                return jnp.zeros(s.shape, s.dtype,
+                                 device=NamedSharding(mesh, spec))
+            return jnp.zeros(s.shape, s.dtype)
+
+        cache = jax.tree_util.tree_map(cache_zeros, shapes["cache"])
 
         @jax.jit
         def step(cache, buf, i, key):

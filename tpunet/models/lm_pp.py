@@ -11,9 +11,13 @@ program, activations hopping stage-to-stage via ``lax.ppermute``.
 Architecture matches tpunet/models/lm.py's TransformerLM: token
 embedding + learned positions -> pre-LN causal blocks -> final LN ->
 logits tied to the embedding transpose. Causality comes from the dense
-attention mask inside block_apply (causal=True); sequence stays whole
-per device (compose with 'data' for DP x PP; ring/Ulysses SP cannot
-nest inside the pipeline's shard_map, same restriction as vit_pp).
+attention mask inside block_apply (causal=True). With
+``--attention ulysses`` the sequence is ALSO sharded (SP x PP, dp x sp
+x pp meshes): the pipeline executor passes the 'seq' axis through its
+shard_map and each stage runs Ulysses' all-to-all pair over it around
+a locally-dense core — global causality is exact because the core sees
+the full sequence per head group. Ring SP remains excluded (its own
+shard_map cannot nest inside the pipeline's).
 
 Dropout is fully supported: the train step's dropout rng threads
 through gpipe, folded per (tick, stage, layer). Grad accumulation
@@ -63,6 +67,8 @@ from flax import linen as nn
 from tpunet.config import ModelConfig
 from tpunet.models.vit_pp import (_dropout, _stacked_lecun_normal,
                                   block_apply, resolve_block_cores)
+from tpunet.ops.attention import (ulysses_attention,
+                                  ulysses_self_attention)
 from tpunet.parallel.pp import gpipe, onef1b
 
 
@@ -140,12 +146,40 @@ class PipelinedLM(nn.Module):
             lambda a: a.astype(self.dtype), blocks)
         heads = self.heads
 
-        seq_core, pipe_core = resolve_block_cores(self.attention)
         pipelined = (self.mesh is not None
                      and self.mesh.shape.get("pipe", 1) > 1)
-        attn = pipe_core if pipelined else seq_core
+        sp = self.attention == "ulysses"
+        if sp:
+            if pipelined:
+                # SP x PP: runs INSIDE the pipeline's shard_map, so the
+                # stage body is already device-local — Ulysses is just
+                # its all-to-all pair over the mesh 'seq' axis around a
+                # locally-dense core (exact global causality: the core
+                # sees the full sequence per head group).
+                def attn(q, k, v, causal=True):
+                    return ulysses_attention(q, k, v, axis_name="seq",
+                                             causal=causal)
+            else:
+                # pipe == 1: the partitioned wrapper shard_maps over
+                # 'seq' per block, same as the unpipelined LM family.
+                def attn(q, k, v, causal=True):
+                    return ulysses_self_attention(q, k, v, self.mesh,
+                                                  causal=causal)
+        else:
+            seq_core, pipe_core = resolve_block_cores(self.attention)
+            attn = pipe_core if pipelined else seq_core
+        sp_in_pipe = sp and pipelined
 
         def stage_apply(params, xs, k=None):
+            if k is not None and sp_in_pipe:
+                # x is seq-sharded inside the pipeline under Ulysses:
+                # without this fold every sequence shard would draw
+                # IDENTICAL dropout masks (correlated positions T/sp
+                # apart). Dense/flash stages must NOT fold — their x is
+                # replicated over 'seq' and diverging masks would break
+                # the replication invariant.
+                k = jax.random.fold_in(k, jax.lax.axis_index("seq"))
+
             def body(carry, inp):
                 pl, i = inp
                 lk = (jax.random.fold_in(k, i) if k is not None else None)
@@ -159,7 +193,8 @@ class PipelinedLM(nn.Module):
         if pipelined:
             executor = onef1b if self.schedule == "1f1b" else gpipe
             x = executor(stage_apply, blocks, x, mesh=self.mesh,
-                         n_micro=self.n_micro, key=key)
+                         n_micro=self.n_micro, key=key,
+                         seq_axis="seq" if sp else None)
         else:
             x = (stage_apply(blocks, x) if key is None
                  else stage_apply(blocks, x, key))
@@ -198,11 +233,20 @@ def to_transformer_lm_params(params: dict) -> dict:
 
 def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
     """Build a PipelinedLM; unsupported 'lm' features fail loudly."""
-    if cfg.attention not in ("dense", "flash", "auto"):
+    if cfg.attention not in ("dense", "flash", "auto", "ulysses"):
         raise ValueError(
-            f"lm_pp supports dense/flash/auto (causal) attention (got "
-            f"{cfg.attention!r}); ring/ulysses cannot nest inside the "
-            "pipeline's shard_map")
+            f"lm_pp supports dense/flash/auto and ulysses (SP x PP) "
+            f"causal attention (got {cfg.attention!r}); ring's own "
+            "shard_map cannot nest inside the pipeline's")
+    if cfg.attention == "ulysses":
+        if mesh is None:
+            raise ValueError("attention='ulysses' requires a mesh")
+        sp_size = mesh.shape.get("seq", 1)
+        if sp_size > 1 and cfg.vit_heads % sp_size:
+            raise ValueError(
+                f"--vit-heads {cfg.vit_heads} not divisible by the "
+                f"mesh 'seq' axis ({sp_size}) — Ulysses re-shards "
+                "heads over it")
     if cfg.moe_experts > 0:
         raise ValueError("lm_pp does not support MoE blocks")
     if cfg.remat:

@@ -33,7 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def gpipe(stage_apply: Callable, stacked_params, x, *,
           mesh: Mesh, n_micro: int, axis_name: str = "pipe",
-          data_axis: str = "data", key=None):
+          data_axis: str = "data", seq_axis: str = None, key=None):
     """Run ``x`` through all pipeline stages.
 
     stage_apply(local_params, x_micro) applies one stage's layer stack
@@ -47,6 +47,12 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     microbatch per stage, identical math under AD.
 
     x: [B, T, C] (batch sharded over ``data_axis``); returns [B, T, C].
+    ``seq_axis`` (SP x PP composition): when given, T is sharded over
+    that mesh axis too and each stage body sees [mb, T/sp, C] — the
+    stage must then handle the sequence sharding itself (Ulysses
+    all-to-alls over ``seq_axis`` inside the stage, tpunet/models/
+    lm_pp.py). Executor logic is untouched: microbatching, ppermute
+    hops and buffers all act on the batch dim only.
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -56,7 +62,7 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     _check_stacked(stacked_params, n_stages)
 
     p_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    x_spec = P(data_axis, None, None)
+    x_spec = P(data_axis, seq_axis, None)
 
     if key is None:
         body = functools.partial(_gpipe_body, stage_apply,
@@ -172,7 +178,7 @@ def onef1b_schedule(n_stages: int, n_micro: int) -> list:
 
 def onef1b(stage_apply: Callable, stacked_params, x, *,
            mesh: Mesh, n_micro: int, axis_name: str = "pipe",
-           data_axis: str = "data", key=None):
+           data_axis: str = "data", seq_axis: str = None, key=None):
     """GPipe-compatible pipeline executor with a manual VJP whose
     backward runs the 1F1B schedule.
 
@@ -210,7 +216,7 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
 
     p_specs = jax.tree_util.tree_map(lambda _: P(axis_name),
                                      stacked_params)
-    x_spec = P(data_axis, None, None)
+    x_spec = P(data_axis, seq_axis, None)
     keyed = key is not None
     kk = key if keyed else jnp.zeros((2,), jnp.uint32)
 
@@ -230,7 +236,7 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     def bwd_program(params, xx, k, dy):
         body = functools.partial(_onef1b_bwd_body, stage_apply,
                                  n_micro=n_micro, axis_name=axis_name,
-                                 data_axis=data_axis,
+                                 data_axis=data_axis, seq_axis=seq_axis,
                                  n_stages=n_stages, keyed=keyed)
         return jax.shard_map(
             body, mesh=mesh, in_specs=(p_specs, x_spec, P(), x_spec),
@@ -256,7 +262,8 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
 
 
 def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
-                     n_micro, axis_name, data_axis, n_stages, keyed):
+                     n_micro, axis_name, data_axis, seq_axis, n_stages,
+                     keyed):
     """Device-local 1F1B backward: one scan over 2(M+S-1) ticks.
 
     Carry: (act_in, cot_in, resid ring, dparam accumulator fp32,
@@ -364,12 +371,17 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
         tick, carry0, jnp.arange(2 * (M + S - 1)))
     # Stage 0 holds the real input-cotangents; replicate like the
     # forward's output buffer. dparams stay per-stage (out spec 'pipe')
-    # but each data shard only saw ITS microbatches — sum the partial
-    # param grads over 'data', the psum GPipe-AD's transpose inserts
-    # for the params' replicated-over-data in_spec.
+    # but each data shard only saw ITS microbatches — and under SP x PP
+    # each seq shard only its token slice — so sum the partial param
+    # grads over 'data' AND (when sharded) the seq axis: exactly the
+    # psums GPipe-AD's transpose inserts for every mesh axis the
+    # params' in_spec replicates over but the cotangent varies over.
+    # (dx needs no seq psum: its out_spec CARRIES the seq sharding.)
     dx = jax.lax.psum(
         jnp.where(s == 0, dxbuf, jnp.zeros_like(dxbuf)), axis_name)
+    grad_axes = ((data_axis,) if seq_axis is None
+                 else (data_axis, seq_axis))
     dparams = jax.tree_util.tree_map(
-        lambda acc, p: jax.lax.psum(acc, data_axis).astype(p.dtype),
+        lambda acc, p: jax.lax.psum(acc, grad_axes).astype(p.dtype),
         dpsum, local_params)
     return dparams, dx.reshape(bl, t, c)
