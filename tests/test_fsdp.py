@@ -158,6 +158,7 @@ def test_fsdp_shards_params_and_moments_and_keeps_parity():
         trainer.close()
 
 
+@pytest.mark.slow
 def test_fsdp_composes_with_tp():
     """TP rules win for matched leaves; FSDP takes the rest."""
     trainer = Trainer(_vit_cfg(MeshConfig(data=4, model=2, fsdp=True)))

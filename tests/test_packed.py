@@ -53,6 +53,7 @@ def test_packed_target_weights():
     np.testing.assert_array_equal(wt, [1, 1, 0, 1, 0, 0, 0])
 
 
+@pytest.mark.slow
 def test_model_segment_isolation():
     """With segment ids, each packed document's logits equal the same
     document run alone — nothing leaks across the packed boundary
@@ -181,6 +182,7 @@ def test_packed_grad_accum_weights_by_valid_count(tmp_path):
     np.testing.assert_allclose(p1, p2, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_packed_grad_accum_moe_aux_equal_weighting():
     """Packed + MoE + grad_accum>1: the CE gradient is normalized by
     the GLOBAL valid-target count, but the count-independent MoE aux

@@ -388,6 +388,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--moe-every", type=int, default=None)
     p.add_argument("--moe-capacity-factor", type=float, default=None)
     p.add_argument("--moe-aux-weight", type=float, default=None)
+    p.add_argument("--dropout-rate", type=float, default=None,
+                   help="dropout rate for every model family (default "
+                        "0.2, torchvision MobileNetV2's classifier "
+                        "dropout; LMs inherit it unless overridden)")
     p.add_argument("--vit-patch", type=int, default=None)
     p.add_argument("--vit-hidden", type=int, default=None)
     p.add_argument("--vit-depth", type=int, default=None)
@@ -489,7 +493,7 @@ def config_from_args(argv=None) -> TrainConfig:
     for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads",
                  "moe_experts", "moe_top_k", "moe_every",
                  "moe_capacity_factor", "moe_aux_weight",
-                 "pp_microbatches", "pp_schedule"):
+                 "pp_microbatches", "pp_schedule", "dropout_rate"):
         val = getattr(args, name)
         if val is not None:
             model = dataclasses.replace(model, **{name: val})

@@ -12,12 +12,18 @@ Architecture matches tpunet/models/lm.py's TransformerLM: token
 embedding + learned positions -> pre-LN causal blocks -> final LN ->
 logits tied to the embedding transpose. Causality comes from the dense
 attention mask inside block_apply (causal=True). With
-``--attention ulysses`` the sequence is ALSO sharded (SP x PP, dp x sp
-x pp meshes): the pipeline executor passes the 'seq' axis through its
-shard_map and each stage runs Ulysses' all-to-all pair over it around
-a locally-dense core — global causality is exact because the core sees
-the full sequence per head group. Ring SP remains excluded (its own
-shard_map cannot nest inside the pipeline's).
+``--attention ulysses`` or ``--attention ring`` the sequence is ALSO
+sharded (SP x PP, dp x sp x pp meshes): the pipeline executor passes
+the 'seq' axis through its shard_map and each stage runs its SP
+collectives over that already-manual axis — Ulysses' all-to-all pair
+around a locally-dense core (exact global causality: the core sees the
+full sequence per head group), or the ring's per-step K/V ppermute
+rotation (exact global causality via global positions,
+tpunet/ops/attention.py ring_attention). Both ops are axis-name
+shard_map-body functions, so no shard_map nesting is involved; pick
+ulysses when the 'seq' axis size divides the head count (2
+collectives/call), ring when it doesn't or when per-hop ICI traffic
+must stay neighbor-only.
 
 Dropout is fully supported: the train step's dropout rng threads
 through gpipe, folded per (tick, stage, layer). Grad accumulation
@@ -67,7 +73,8 @@ from flax import linen as nn
 from tpunet.config import ModelConfig
 from tpunet.models.vit_pp import (_dropout, _stacked_lecun_normal,
                                   block_apply, resolve_block_cores)
-from tpunet.ops.attention import (ulysses_attention,
+from tpunet.ops.attention import (ring_attention, ring_self_attention,
+                                  ulysses_attention,
                                   ulysses_self_attention)
 from tpunet.parallel.pp import gpipe, onef1b
 
@@ -83,7 +90,9 @@ class PipelinedLM(nn.Module):
     max_len: int = 1024
     n_micro: int = 4
     dropout_rate: float = 0.0
-    attention: str = "dense"           # dense | flash | auto
+    attention: str = "dense"   # dense | flash | auto | ulysses | ring
+    attention_core: Any = None         # SP local core (None = auto)
+    attention_block: int = 512         # blockwise/flash block inside SP
     schedule: str = "gpipe"            # gpipe | 1f1b (pp.py executors)
     mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
@@ -148,23 +157,39 @@ class PipelinedLM(nn.Module):
 
         pipelined = (self.mesh is not None
                      and self.mesh.shape.get("pipe", 1) > 1)
-        sp = self.attention == "ulysses"
+        sp = self.attention in ("ulysses", "ring")
         if sp:
             if pipelined:
                 # SP x PP: runs INSIDE the pipeline's shard_map, so the
-                # stage body is already device-local — Ulysses is just
-                # its all-to-all pair over the mesh 'seq' axis around a
-                # locally-dense core (exact global causality: the core
-                # sees the full sequence per head group).
-                def attn(q, k, v, causal=True):
-                    return ulysses_attention(q, k, v, axis_name="seq",
-                                             causal=causal)
-            else:
+                # stage body is already device-local — both SP ops are
+                # axis-name collectives over the mesh 'seq' axis:
+                # Ulysses' all-to-all pair around a locally-dense core,
+                # or the ring's K/V rotation (global positions keep
+                # causality exact either way).
+                if self.attention == "ulysses":
+                    def attn(q, k, v, causal=True):
+                        return ulysses_attention(
+                            q, k, v, axis_name="seq", causal=causal,
+                            core=self.attention_core,
+                            block=self.attention_block)
+                else:
+                    def attn(q, k, v, causal=True):
+                        return ring_attention(q, k, v, "seq",
+                                              causal=causal,
+                                              core=self.attention_core)
+            elif self.attention == "ulysses":
                 # pipe == 1: the partitioned wrapper shard_maps over
                 # 'seq' per block, same as the unpipelined LM family.
                 def attn(q, k, v, causal=True):
-                    return ulysses_self_attention(q, k, v, self.mesh,
-                                                  causal=causal)
+                    return ulysses_self_attention(
+                        q, k, v, self.mesh, causal=causal,
+                        core=self.attention_core,
+                        block=self.attention_block)
+            else:
+                def attn(q, k, v, causal=True):
+                    return ring_self_attention(q, k, v, self.mesh,
+                                               causal=causal,
+                                               core=self.attention_core)
         else:
             seq_core, pipe_core = resolve_block_cores(self.attention)
             attn = pipe_core if pipelined else seq_core
@@ -172,8 +197,9 @@ class PipelinedLM(nn.Module):
 
         def stage_apply(params, xs, k=None):
             if k is not None and sp_in_pipe:
-                # x is seq-sharded inside the pipeline under Ulysses:
-                # without this fold every sequence shard would draw
+                # x is seq-sharded inside the pipeline under SP
+                # (ulysses or ring): without this fold every
+                # sequence shard would draw
                 # IDENTICAL dropout masks (correlated positions T/sp
                 # apart). Dense/flash stages must NOT fold — their x is
                 # replicated over 'seq' and diverging masks would break
@@ -233,20 +259,21 @@ def to_transformer_lm_params(params: dict) -> dict:
 
 def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
     """Build a PipelinedLM; unsupported 'lm' features fail loudly."""
-    if cfg.attention not in ("dense", "flash", "auto", "ulysses"):
+    if cfg.attention not in ("dense", "flash", "auto", "ulysses", "ring"):
         raise ValueError(
-            f"lm_pp supports dense/flash/auto and ulysses (SP x PP) "
-            f"causal attention (got {cfg.attention!r}); ring's own "
-            "shard_map cannot nest inside the pipeline's")
-    if cfg.attention == "ulysses":
+            f"lm_pp supports dense/flash/auto and ulysses/ring (SP x "
+            f"PP) causal attention (got {cfg.attention!r})")
+    if cfg.attention in ("ulysses", "ring"):
         if mesh is None:
-            raise ValueError("attention='ulysses' requires a mesh")
+            raise ValueError(
+                f"attention={cfg.attention!r} requires a mesh")
         sp_size = mesh.shape.get("seq", 1)
-        if sp_size > 1 and cfg.vit_heads % sp_size:
+        if (cfg.attention == "ulysses" and sp_size > 1
+                and cfg.vit_heads % sp_size):
             raise ValueError(
                 f"--vit-heads {cfg.vit_heads} not divisible by the "
                 f"mesh 'seq' axis ({sp_size}) — Ulysses re-shards "
-                "heads over it")
+                "heads over it (ring SP has no head constraint)")
     if cfg.moe_experts > 0:
         raise ValueError("lm_pp does not support MoE blocks")
     if cfg.remat:
@@ -273,6 +300,9 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
         n_micro=cfg.pp_microbatches,
         dropout_rate=cfg.dropout_rate,
         attention=cfg.attention,
+        attention_core=(None if cfg.attention_core == "auto"
+                        else cfg.attention_core),
+        attention_block=cfg.attention_block,
         schedule=cfg.pp_schedule,
         mesh=mesh,
         dtype=jnp.dtype(cfg.dtype),

@@ -17,8 +17,9 @@ replicated on every pipe stage rather than being assigned to first/last
 stages (standard trick — keeps the pipeline body uniform).
 
 Differences from the dense ViT (documented, deliberate): the attention
-core is dense, flash, or auto only (ring/blockwise's own shard_map
-cannot nest inside the pipeline's); flash picks the kernel variant by
+core is dense, flash, or auto only — sequence parallelism lives in the
+LM family (tpunet/models/lm_pp.py ulysses|ring), where sequences are
+long enough to shard; flash picks the kernel variant by
 context — see resolve_block_cores. Dropout IS supported: a PRNG key
 threads through the GPipe executor, folded per (tick, stage, layer) —
 see block_apply.
@@ -224,8 +225,9 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
     if cfg.attention not in ("dense", "flash", "auto"):
         raise ValueError(
             f"vit_pp supports dense/flash/auto attention (got "
-            f"{cfg.attention!r}); ring/blockwise cannot nest inside the "
-            "pipeline's shard_map")
+            f"{cfg.attention!r}); sequence parallelism is the LM "
+            "family's (lm/lm_pp ulysses|ring) — a 64-token patch grid "
+            "has nothing to shard")
     if cfg.moe_experts > 0:
         raise ValueError("vit_pp does not support MoE blocks")
     if cfg.pp_schedule not in ("gpipe", "1f1b"):

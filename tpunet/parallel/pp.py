@@ -49,10 +49,11 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     x: [B, T, C] (batch sharded over ``data_axis``); returns [B, T, C].
     ``seq_axis`` (SP x PP composition): when given, T is sharded over
     that mesh axis too and each stage body sees [mb, T/sp, C] — the
-    stage must then handle the sequence sharding itself (Ulysses
-    all-to-alls over ``seq_axis`` inside the stage, tpunet/models/
-    lm_pp.py). Executor logic is untouched: microbatching, ppermute
-    hops and buffers all act on the batch dim only.
+    stage must then handle the sequence sharding itself via axis-name
+    collectives over ``seq_axis`` (Ulysses all-to-alls or ring
+    ppermute rotations, tpunet/models/lm_pp.py). Executor logic is
+    untouched: microbatching, ppermute hops and buffers all act on
+    the batch dim only.
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -205,8 +206,14 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     hoisted out of the fwd/bwd branch (``lax.cond`` branches must not
     diverge on collectives): every tick runs exactly one forward-shift
     and one reverse-shift ``ppermute``, with zeros masked in for
-    whichever stream a stage isn't driving. Double differentiation is
-    not supported (custom_vjp).
+    whichever stream a stage isn't driving. Under SP x PP
+    (``seq_axis`` given) the stage BODY itself contains seq
+    collectives, so the F/B ``lax.cond`` disappears entirely: each
+    tick runs one ``jax.vjp`` on a role-selected input, keeping the
+    collective sequence identical on every device every tick
+    (branch-divergent in-stage collectives measurably corrupt
+    gradients — see the body comment). Double differentiation is not
+    supported (custom_vjp).
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -321,22 +328,45 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
         b_inp = jax.lax.dynamic_index_in_dim(resid, b_slot, 0,
                                              keepdims=False)
 
-        zero_dp = jax.tree_util.tree_map(jnp.zeros_like, local_params)
-
-        def do_f(_):
-            y = apply_f(local_params, f_inp, m_fc)
-            return y, jnp.zeros_like(f_inp), zero_dp
-
-        def do_b(_):
-            # Recompute this stage's forward and pull the cotangent
-            # back through it — idle ticks also land here on zeros,
-            # masked out below.
-            _, pull = jax.vjp(lambda p, xi: apply_f(p, xi, m_bc),
-                              local_params, b_inp)
+        if seq_axis is not None:
+            # SP x PP: the stage body contains collectives over
+            # ``seq_axis`` (ring ppermutes / Ulysses all-to-alls).
+            # Those must NOT sit inside diverging lax.cond branches:
+            # the F/B predicate varies over 'pipe', so stages would
+            # execute DIFFERENT collective ops whose participant sets
+            # span all stages — undefined pairing (measured: wrong
+            # gradients with a ring stage; a deadlock risk on real
+            # ICI). Instead run ONE vjp per tick on a role-selected
+            # input — every device then executes an identical
+            # collective sequence every tick; the unused half of each
+            # (primal, pulled-grad) pair is masked below. Costs a
+            # wasted pull on F-ticks, the price of collective
+            # uniformity.
+            m_sel = jnp.where(f_valid, m_fc, m_bc)
+            inp = jnp.where(f_valid, f_inp, b_inp)
+            y, pull = jax.vjp(lambda p, xi: apply_f(p, xi, m_sel),
+                              local_params, inp)
             dp, dx = pull(g_in)
-            return jnp.zeros_like(f_inp), dx, dp
+        else:
+            # No seq sharding -> stage bodies are collective-free and
+            # the cheap schedule runs only the branch each tick needs.
+            zero_dp = jax.tree_util.tree_map(jnp.zeros_like,
+                                             local_params)
 
-        y, dx, dp = jax.lax.cond(f_valid, do_f, do_b, None)
+            def do_f(_):
+                yf = apply_f(local_params, f_inp, m_fc)
+                return yf, jnp.zeros_like(f_inp), zero_dp
+
+            def do_b(_):
+                # Recompute this stage's forward and pull the cotangent
+                # back through it — idle ticks also land here on zeros,
+                # masked out below.
+                _, pull = jax.vjp(lambda p, xi: apply_f(p, xi, m_bc),
+                                  local_params, b_inp)
+                dpb, dxb = pull(g_in)
+                return jnp.zeros_like(f_inp), dxb, dpb
+
+            y, dx, dp = jax.lax.cond(f_valid, do_f, do_b, None)
         y = jnp.where(f_valid, y, jnp.zeros_like(y))
         dx = jnp.where(b_valid, dx, jnp.zeros_like(dx))
         dpsum = jax.tree_util.tree_map(
