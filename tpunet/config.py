@@ -383,7 +383,8 @@ def build_argparser() -> argparse.ArgumentParser:
                         "activation memory; full-batch gradient math "
                         "except per-microbatch BN stats/augment RNG)")
     p.add_argument("--moe-experts", type=int, default=None,
-                   help="experts per MoE block (ViT); 0 = dense MLPs")
+                   help="experts per MoE block (vit/lm/lm_pp); "
+                        "0 = dense MLPs")
     p.add_argument("--moe-top-k", type=int, default=None)
     p.add_argument("--moe-every", type=int, default=None)
     p.add_argument("--moe-capacity-factor", type=float, default=None)

@@ -157,6 +157,12 @@ def main(argv=None):
     p.add_argument("--vit-heads", type=int, default=3)
     p.add_argument("--vocab-size", type=int, default=256)
     p.add_argument("--max-seq-len", type=int, default=1024)
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="experts per MoE block of the trained "
+                        "checkpoint (0 = dense MLPs)")
+    p.add_argument("--moe-every", type=int, default=2)
+    p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25)
     p.add_argument("--mesh-model", type=int, default=0,
                    help="tensor-parallel serving: shard block weights "
                         "(and the KV cache's head dim) over N devices "
@@ -186,7 +192,11 @@ def main(argv=None):
     cfg = ModelConfig(name=args.model, vit_hidden=args.vit_hidden,
                       vit_depth=args.vit_depth, vit_heads=args.vit_heads,
                       vocab_size=args.vocab_size,
-                      max_seq_len=args.max_seq_len, dropout_rate=0.0)
+                      max_seq_len=args.max_seq_len, dropout_rate=0.0,
+                      moe_experts=args.moe_experts,
+                      moe_every=args.moe_every,
+                      moe_top_k=args.moe_top_k,
+                      moe_capacity_factor=args.moe_capacity_factor)
     if byte_prompt:
         # Byte-level checkpoint (--dataset text_lm): the prompt IS text.
         prompt_len = len(args.prompt.encode("utf-8"))

@@ -30,6 +30,23 @@ through gpipe, folded per (tick, stage, layer). Grad accumulation
 composes too — the accumulation scan in steps.py wraps the whole
 pipelined program (microbatching in TIME over microbatching in STAGES).
 
+MoE composes as well (EP x PP): with ``--moe-experts`` the stacks are
+organized as SUPER-layers — ``moe_every - 1`` dense blocks plus one
+routed block per scan step — so the per-stage program stays one
+uniform ``lax.scan`` despite heterogeneous layers (depth must divide
+into whole super-layers, and super-layers across stages). The routed
+block runs the same functional core as MoeMlp
+(tpunet/models/moe.py moe_apply); the load-balance aux loss threads
+through the executors' ``with_aux`` contract (sum over stages, mean
+over microbatch-shards — the equal-weight semantics grad-accum uses,
+tpunet/train/steps.py) and is sown into the standard 'losses'
+collection. With pipe > 1 each microbatch-shard routes its tokens
+independently with per-shard capacity (the standard shard_map MoE
+scope; the unpipelined model under GSPMD routes globally — documented
+deviation, exact parity at n_micro=1). Experts are replicated within
+a stage (the expert einsums' 'model'-axis sharding applies to the
+unpipelined family only).
+
 With pipe == 1 the stacked params run as a plain lax.scan over layers —
 the same math, which the parity tests assert. No KV-cache decode path
 in this module: generation/serving unstacks lm_pp checkpoints into the
@@ -71,12 +88,55 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from tpunet.config import ModelConfig
+from tpunet.models.moe import moe_apply
 from tpunet.models.vit_pp import (_dropout, _stacked_lecun_normal,
-                                  block_apply, resolve_block_cores)
+                                  attn_half_apply, block_apply,
+                                  resolve_block_cores)
 from tpunet.ops.attention import (ring_attention, ring_self_attention,
                                   ulysses_attention,
                                   ulysses_self_attention)
 from tpunet.parallel.pp import gpipe, onef1b
+
+
+def _stacked_expert_normal(key, shape, dtype=jnp.float32):
+    """flax variance_scaling(2.0, fan_in, truncated_normal) for stacked
+    [G, e, d_in, d_out] expert kernels, matching MoeMlp's UNSTACKED
+    [e, d_in, d_out] fan exactly (flax treats leading dims as the
+    receptive field: fan_in = e * d_in) — the stacked G dim must not
+    fold into the fan."""
+    fan_in = shape[-3] * shape[-2]
+    std = (2.0 / fan_in) ** 0.5 / 0.87962566103423978
+    return std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+
+_ATTN_KEYS = ("ln1s", "ln1b", "qkv_k", "qkv_b", "out_k", "out_b",
+              "ln2s", "ln2b")
+_FC_KEYS = ("fc1_k", "fc1_b", "fc2_k", "fc2_b")
+_MOE_KEYS = ("rk", "rb", "wi", "bi", "wo", "bo")
+
+
+def _moe_block_apply(pa, pm, x, *, heads, top_k, capacity_factor,
+                     dropout_rate=0.0, key=None, attn):
+    """One pre-LN block whose MLP is the routed MoE core: the shared
+    attention half (vit_pp.attn_half_apply — same dropout placements
+    and key split as dense blocks), then moe_apply
+    (tpunet/models/moe.py) instead of the dense fc pair. Router math
+    in float32 on the float32 router params (the stacked analogue of
+    MoeMlp's float32 Dense). Returns (x, aux)."""
+    mb, t, c = x.shape
+    x, y, km = attn_half_apply(pa, x, heads=heads, causal=True,
+                               dropout_rate=dropout_rate, key=key,
+                               attn=attn)
+    tokens = y.reshape(mb * t, c)
+    logits = (tokens.astype(jnp.float32) @ pm["rk"].astype(jnp.float32)
+              + pm["rb"].astype(jnp.float32))
+    out, aux = moe_apply(tokens, logits, pm["wi"], pm["bi"], pm["wo"],
+                         pm["bo"], top_k=top_k,
+                         capacity_factor=capacity_factor, dtype=x.dtype)
+    out = out.reshape(mb, t, c)
+    if dropout_rate > 0.0 and km is not None:
+        out = _dropout(out, dropout_rate, km)
+    return x + out, aux
 
 
 class PipelinedLM(nn.Module):
@@ -90,6 +150,10 @@ class PipelinedLM(nn.Module):
     max_len: int = 1024
     n_micro: int = 4
     dropout_rate: float = 0.0
+    moe_experts: int = 0               # 0 = dense MLP everywhere
+    moe_every: int = 2                 # MoE in every moe_every-th block
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     attention: str = "dense"   # dense | flash | auto | ulysses | ring
     attention_core: Any = None         # SP local core (None = auto)
     attention_block: int = 512         # blockwise/flash block inside SP
@@ -125,6 +189,18 @@ class PipelinedLM(nn.Module):
         zeros = nn.initializers.zeros
         winit = _stacked_lecun_normal
         L, C, H = self.depth, self.hidden, int(self.hidden * self.mlp_ratio)
+        moe = self.moe_experts > 0
+        m_every = self.moe_every if moe else 1
+        if moe and L % m_every:
+            raise ValueError(f"depth {L} not divisible by moe_every "
+                             f"{m_every} (whole super-layers required)")
+        G = L // m_every
+        # Dense-MLP stacks cover only the dense slots: with MoE every
+        # m_every-th block routes instead, so the fc stacks hold
+        # G * (m_every - 1) layers ordered by (super-layer, slot) —
+        # matching TransformerLM's layout where MoE blocks have no
+        # dense mlp params at all.
+        n_fc = G * (m_every - 1) if moe else L
         blocks = {
             "ln1s": self.param("blocks_ln1s", ln_ones, (L, C),
                                self.param_dtype),
@@ -142,17 +218,43 @@ class PipelinedLM(nn.Module):
                                self.param_dtype),
             "ln2b": self.param("blocks_ln2b", zeros, (L, C),
                                self.param_dtype),
-            "fc1_k": self.param("blocks_fc1_k", winit, (L, C, H),
-                                self.param_dtype),
-            "fc1_b": self.param("blocks_fc1_b", zeros, (L, H),
-                                self.param_dtype),
-            "fc2_k": self.param("blocks_fc2_k", winit, (L, H, C),
-                                self.param_dtype),
-            "fc2_b": self.param("blocks_fc2_b", zeros, (L, C),
-                                self.param_dtype),
         }
+        if n_fc > 0:
+            blocks.update({
+                "fc1_k": self.param("blocks_fc1_k", winit, (n_fc, C, H),
+                                    self.param_dtype),
+                "fc1_b": self.param("blocks_fc1_b", zeros, (n_fc, H),
+                                    self.param_dtype),
+                "fc2_k": self.param("blocks_fc2_k", winit, (n_fc, H, C),
+                                    self.param_dtype),
+                "fc2_b": self.param("blocks_fc2_b", zeros, (n_fc, C),
+                                    self.param_dtype),
+            })
         blocks = jax.tree_util.tree_map(
             lambda a: a.astype(self.dtype), blocks)
+        if moe:
+            E = self.moe_experts
+            # Router params stay float32 (MoeMlp's float32 Dense);
+            # expert kernels keep param_dtype and moe_apply casts them
+            # to the compute dtype itself — so none of these take the
+            # blanket dtype cast above.
+            blocks.update({
+                "moe_rk": self.param(
+                    "blocks_moe_rk", nn.initializers.normal(stddev=0.02),
+                    (G, C, E), jnp.float32),
+                "moe_rb": self.param("blocks_moe_rb", zeros, (G, E),
+                                     jnp.float32),
+                "moe_wi": self.param("blocks_moe_wi",
+                                     _stacked_expert_normal, (G, E, C, H),
+                                     self.param_dtype),
+                "moe_bi": self.param("blocks_moe_bi", zeros, (G, E, H),
+                                     self.param_dtype),
+                "moe_wo": self.param("blocks_moe_wo",
+                                     _stacked_expert_normal, (G, E, H, C),
+                                     self.param_dtype),
+                "moe_bo": self.param("blocks_moe_bo", zeros, (G, E, C),
+                                     self.param_dtype),
+            })
         heads = self.heads
 
         pipelined = (self.mesh is not None
@@ -195,6 +297,8 @@ class PipelinedLM(nn.Module):
             attn = pipe_core if pipelined else seq_core
         sp_in_pipe = sp and pipelined
 
+        top_k, cap_f = self.moe_top_k, self.moe_capacity_factor
+
         def stage_apply(params, xs, k=None):
             if k is not None and sp_in_pipe:
                 # x is seq-sharded inside the pipeline under SP
@@ -206,24 +310,76 @@ class PipelinedLM(nn.Module):
                 # the replication invariant.
                 k = jax.random.fold_in(k, jax.lax.axis_index("seq"))
 
+            if not moe:
+                def body(carry, inp):
+                    pl, i = inp
+                    lk = (jax.random.fold_in(k, i) if k is not None
+                          else None)
+                    return block_apply(pl, carry, heads=heads,
+                                       causal=True, dropout_rate=rate,
+                                       key=lk, attn=attn), None
+                idx = jnp.arange(
+                    jax.tree_util.tree_leaves(params)[0].shape[0])
+                out, _ = jax.lax.scan(body, xs, (params, idx))
+                return out
+
+            # MoE: scan over SUPER-layers (m_every - 1 dense blocks +
+            # one MoE block each) so the per-stage program stays a
+            # uniform lax.scan despite heterogeneous layers. The local
+            # [L_local, ...] stacks reshape to [G_local, slot, ...]
+            # (contiguous, since stages hold whole super-layers).
+            gl = params["moe_wi"].shape[0]
+            pa = {kk: params[kk].reshape((gl, m_every)
+                                         + params[kk].shape[1:])
+                  for kk in _ATTN_KEYS}
+            pf = ({kk: params[kk].reshape((gl, m_every - 1)
+                                          + params[kk].shape[1:])
+                   for kk in _FC_KEYS} if m_every > 1 else {})
+            pm = {kk: params["moe_" + kk] for kk in _MOE_KEYS}
+
             def body(carry, inp):
-                pl, i = inp
-                lk = (jax.random.fold_in(k, i) if k is not None else None)
-                return block_apply(pl, carry, heads=heads, causal=True,
-                                   dropout_rate=rate, key=lk,
-                                   attn=attn), None
-            idx = jnp.arange(jax.tree_util.tree_leaves(params)[0].shape[0])
-            out, _ = jax.lax.scan(body, xs, (params, idx))
-            return out
+                xc, auxc = carry
+                pa_g, pf_g, pm_g, g = inp
+                for j in range(m_every - 1):
+                    pl = {kk: pa_g[kk][j] for kk in _ATTN_KEYS}
+                    pl.update({kk: pf_g[kk][j] for kk in _FC_KEYS})
+                    lk = (jax.random.fold_in(k, g * m_every + j)
+                          if k is not None else None)
+                    xc = block_apply(pl, xc, heads=heads, causal=True,
+                                     dropout_rate=rate, key=lk,
+                                     attn=attn)
+                pl = {kk: pa_g[kk][m_every - 1] for kk in _ATTN_KEYS}
+                lk = (jax.random.fold_in(k, g * m_every + m_every - 1)
+                      if k is not None else None)
+                xc, a = _moe_block_apply(pl, pm_g, xc, heads=heads,
+                                         top_k=top_k,
+                                         capacity_factor=cap_f,
+                                         dropout_rate=rate, key=lk,
+                                         attn=attn)
+                return (xc, auxc + a), None
+
+            (out, aux), _ = jax.lax.scan(
+                body, (xs, jnp.zeros((), jnp.float32)),
+                (pa, pf, pm, jnp.arange(gl)))
+            return out, aux
 
         if pipelined:
             executor = onef1b if self.schedule == "1f1b" else gpipe
             x = executor(stage_apply, blocks, x, mesh=self.mesh,
                          n_micro=self.n_micro, key=key,
-                         seq_axis="seq" if sp else None)
+                         seq_axis="seq" if sp else None,
+                         with_aux=moe)
         else:
             x = (stage_apply(blocks, x) if key is None
                  else stage_apply(blocks, x, key))
+        if moe:
+            # One scalar for the whole program: sum over layers, and
+            # with pipe > 1 the executor's mean over microbatch-shards
+            # (tpunet/parallel/pp.py gpipe docstring). Sown into the
+            # standard 'losses' collection, so the train step's
+            # _aux_term picks it up exactly like MoeMlp's sow.
+            x, aux = x
+            self.sow("losses", "moe_aux", aux)
 
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln")(x)
@@ -235,12 +391,17 @@ def to_transformer_lm_params(params: dict) -> dict:
     """Unstack a PipelinedLM param tree into TransformerLM's layout
     (block{i:02d}/attn/..., tpunet/models/lm.py) — the two are the same
     architecture, so lm_pp training checkpoints serve through the
-    TransformerLM KV-cache generation path."""
+    TransformerLM KV-cache generation path. MoE stacks (present when
+    the model was trained with --moe-experts) unstack into the
+    block{i}/moe/{router, wi, bi, wo, bo} layout of MoeMlp; the MoE
+    period is recovered from the stack shapes (L / G)."""
     out = {"embed": params["embed"], "pos_embed": params["pos_embed"],
            "ln": params["ln"]}
     L = params["blocks_qkv_k"].shape[0]
+    moe = "blocks_moe_wi" in params
+    m_every = L // params["blocks_moe_wi"].shape[0] if moe else 0
     for i in range(L):
-        out[f"block{i:02d}"] = {
+        block = {
             "ln1": {"scale": params["blocks_ln1s"][i],
                     "bias": params["blocks_ln1b"][i]},
             "attn": {"qkv": {"kernel": params["blocks_qkv_k"][i],
@@ -249,11 +410,25 @@ def to_transformer_lm_params(params: dict) -> dict:
                              "bias": params["blocks_out_b"][i]}},
             "ln2": {"scale": params["blocks_ln2s"][i],
                     "bias": params["blocks_ln2b"][i]},
-            "mlp": {"fc1": {"kernel": params["blocks_fc1_k"][i],
-                            "bias": params["blocks_fc1_b"][i]},
-                    "fc2": {"kernel": params["blocks_fc2_k"][i],
-                            "bias": params["blocks_fc2_b"][i]}},
         }
+        if moe and i % m_every == m_every - 1:
+            g = i // m_every
+            block["moe"] = {
+                "router": {"kernel": params["blocks_moe_rk"][g],
+                           "bias": params["blocks_moe_rb"][g]},
+                "wi": params["blocks_moe_wi"][g],
+                "bi": params["blocks_moe_bi"][g],
+                "wo": params["blocks_moe_wo"][g],
+                "bo": params["blocks_moe_bo"][g],
+            }
+        else:
+            fi = ((i // m_every) * (m_every - 1) + i % m_every
+                  if moe else i)
+            block["mlp"] = {"fc1": {"kernel": params["blocks_fc1_k"][fi],
+                                    "bias": params["blocks_fc1_b"][fi]},
+                            "fc2": {"kernel": params["blocks_fc2_k"][fi],
+                                    "bias": params["blocks_fc2_b"][fi]}}
+        out[f"block{i:02d}"] = block
     return out
 
 
@@ -275,7 +450,20 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
                 f"mesh 'seq' axis ({sp_size}) — Ulysses re-shards "
                 "heads over it (ring SP has no head constraint)")
     if cfg.moe_experts > 0:
-        raise ValueError("lm_pp does not support MoE blocks")
+        if cfg.moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got "
+                             f"{cfg.moe_every}")
+        if cfg.vit_depth % cfg.moe_every:
+            raise ValueError(
+                f"--vit-depth {cfg.vit_depth} not divisible by "
+                f"--moe-every {cfg.moe_every}: lm_pp stacks whole "
+                "super-layers (moe_every-1 dense blocks + 1 MoE block)")
+        stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        if stages > 1 and (cfg.vit_depth // cfg.moe_every) % stages:
+            raise ValueError(
+                f"{cfg.vit_depth // cfg.moe_every} MoE super-layers "
+                f"(depth {cfg.vit_depth} / moe_every {cfg.moe_every}) "
+                f"not divisible by {stages} pipeline stages")
     if cfg.remat:
         raise ValueError("lm_pp does not support --remat (the pipeline "
                          "scan already bounds activation memory per "
@@ -299,6 +487,10 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
         max_len=cfg.max_seq_len,
         n_micro=cfg.pp_microbatches,
         dropout_rate=cfg.dropout_rate,
+        moe_experts=cfg.moe_experts,
+        moe_every=cfg.moe_every,
+        moe_top_k=cfg.moe_top_k,
+        moe_capacity_factor=cfg.moe_capacity_factor,
         attention=cfg.attention,
         attention_core=(None if cfg.attention_core == "auto"
                         else cfg.attention_core),

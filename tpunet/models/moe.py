@@ -33,6 +33,66 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
+def moe_apply(tokens, router_logits, wi, bi, wo, bo, *,
+              top_k: int, capacity_factor: float, dtype) -> tuple:
+    """Functional MoE MLP core: ``tokens`` [n, d] + float32 router
+    logits [n, e] -> ([n, d], aux).
+
+    The routing/dispatch/FFN math of :class:`MoeMlp` as a pure function
+    of its parameters, shared by the flax module (which adds the
+    router Dense, dropout and sow around it) and the stacked pipelined
+    LM (tpunet/models/lm_pp.py), whose params carry a leading layer
+    dim and cannot be flax submodules. Callers compute the router
+    logits in float32 — gate probabilities are numerically
+    load-bearing and tiny relative to the FFN cost; ``aux`` is the
+    Shazeer load-balance term computed over exactly the ``n`` tokens
+    given (callers decide the batch scope: global under GSPMD,
+    per-shard inside shard_map).
+    """
+    n, d = tokens.shape
+    e = wi.shape[0]
+    k = min(top_k, e)
+    cap = max(k, math.ceil(k * n / e * capacity_factor))
+
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)    # [n, k]
+    gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+    # Position of each (token, slot) inside its expert's buffer,
+    # slot-major so slot-0 assignments win buffer space first.
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [n,k,e]
+    flat = onehot.transpose(1, 0, 2).reshape(k * n, e)  # slot-major
+    pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0    # [k*n, e]
+    pos = pos_flat.reshape(k, n, e).transpose(1, 0, 2)  # [n, k, e]
+    fits = (pos >= 0) & (pos < cap)
+
+    # dispatch[n, e, c] in {0,1}; combine = dispatch * gate value.
+    pos_cap = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_cap, cap, dtype=jnp.float32)
+    kept = onehot * fits.astype(jnp.float32)            # [n, k, e]
+    dispatch = jnp.einsum("nke,nkec->nec", kept, pos_onehot)
+    combine = jnp.einsum("nke,nkec->nec",
+                         kept * gate_vals[:, :, None], pos_onehot)
+
+    # Load-balance aux loss (fraction dispatched x mean router prob).
+    frac = jnp.sum(dispatch, axis=(0, 2)) / jnp.maximum(
+        jnp.sum(dispatch), 1.0)                         # [e]
+    mean_prob = jnp.mean(probs, axis=0)                 # [e]
+    aux = e * jnp.sum(frac * mean_prob)
+
+    # Expert FFN: one batched einsum pair over the expert dim; the
+    # expert axis of wi/wo is what expert parallelism shards.
+    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype),
+                     tokens.astype(dtype))
+    h = jnp.einsum("ecd,edf->ecf", xin, wi.astype(dtype))
+    h = nn.gelu(h + bi[:, None, :].astype(dtype))
+    out = jnp.einsum("ecf,efd->ecd", h, wo.astype(dtype))
+    out = out + bo[:, None, :].astype(dtype)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(dtype), out)
+    return y, aux
+
+
 class MoeMlp(nn.Module):
     """Sparse MLP: top-k routed experts, capacity-bounded dense dispatch.
 
@@ -50,47 +110,13 @@ class MoeMlp(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         b, t, d = x.shape
-        e, k = self.num_experts, min(self.top_k, self.num_experts)
-        n = b * t
-        cap = max(k, math.ceil(k * n / e * self.capacity_factor))
-        tokens = x.reshape(n, d)
+        e = self.num_experts
+        tokens = x.reshape(b * t, d)
 
-        # Router in float32 — gate probabilities are numerically load-
-        # bearing and tiny relative to the FFN cost.
         logits = nn.Dense(e, dtype=jnp.float32,
                           param_dtype=jnp.float32,
                           kernel_init=nn.initializers.normal(stddev=0.02),
                           name="router")(tokens.astype(jnp.float32))
-        probs = jax.nn.softmax(logits, axis=-1)            # [n, e]
-
-        gate_vals, expert_idx = jax.lax.top_k(probs, k)    # [n, k]
-        gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
-
-        # Position of each (token, slot) inside its expert's buffer,
-        # slot-major so slot-0 assignments win buffer space first.
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [n,k,e]
-        flat = onehot.transpose(1, 0, 2).reshape(k * n, e)  # slot-major
-        pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0    # [k*n, e]
-        pos = pos_flat.reshape(k, n, e).transpose(1, 0, 2)  # [n, k, e]
-        fits = (pos >= 0) & (pos < cap)
-
-        # dispatch[n, e, c] in {0,1}; combine = dispatch * gate value.
-        pos_cap = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
-        pos_onehot = jax.nn.one_hot(pos_cap, cap, dtype=jnp.float32)
-        kept = onehot * fits.astype(jnp.float32)            # [n, k, e]
-        dispatch = jnp.einsum("nke,nkec->nec", kept, pos_onehot)
-        combine = jnp.einsum("nke,nkec->nec",
-                             kept * gate_vals[:, :, None], pos_onehot)
-
-        # Load-balance aux loss (fraction dispatched x mean router prob).
-        frac = jnp.sum(dispatch, axis=(0, 2)) / jnp.maximum(
-            jnp.sum(dispatch), 1.0)                         # [e]
-        mean_prob = jnp.mean(probs, axis=0)                 # [e]
-        aux = e * jnp.sum(frac * mean_prob)
-        self.sow("losses", "moe_aux", aux)
-
-        # Expert FFN: one batched einsum pair over the expert dim; the
-        # expert axis of wi/wo is what expert parallelism shards.
         wi = self.param("wi", nn.initializers.variance_scaling(
             2.0, "fan_in", "truncated_normal"), (e, d, self.mlp_dim),
             self.param_dtype)
@@ -101,13 +127,10 @@ class MoeMlp(nn.Module):
             self.param_dtype)
         bo = self.param("bo", nn.initializers.zeros, (e, d),
                         self.param_dtype)
-
-        xin = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype),
-                         tokens.astype(self.dtype))
-        h = jnp.einsum("ecd,edf->ecf", xin, wi.astype(self.dtype))
-        h = nn.gelu(h + bi[:, None, :].astype(self.dtype))
-        out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
-        out = out + bo[:, None, :].astype(self.dtype)
-        y = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), out)
+        y, aux = moe_apply(
+            tokens, logits, wi, bi, wo, bo,
+            top_k=self.top_k, capacity_factor=self.capacity_factor,
+            dtype=self.dtype)
+        self.sow("losses", "moe_aux", aux)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return y.reshape(b, t, d)

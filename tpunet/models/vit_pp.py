@@ -83,6 +83,29 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
 
 
+def attn_half_apply(p, x, *, heads, causal=False, dropout_rate=0.0,
+                    key=None, attn=dense_attention):
+    """The attention half of a pre-LN block: ln1 -> qkv -> ``attn`` ->
+    out-projection -> dropout -> residual, then ln2. Returns
+    ``(x_resid, y_ln2, mlp_key)`` — the post-residual activations, the
+    ln2 output feeding whichever MLP follows (dense fc pair or the MoE
+    core), and the second half of the dropout key split (None when
+    dropout is off), so both block kinds share one dropout placement
+    and key-split convention."""
+    mb, t, c = x.shape
+    y = _layer_norm(x, p["ln1s"], p["ln1b"])
+    qkv = y @ p["qkv_k"] + p["qkv_b"]
+    qkv = qkv.reshape(mb, t, 3, heads, c // heads)
+    a = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=causal)
+    a = a.reshape(mb, t, c) @ p["out_k"] + p["out_b"]
+    km = None
+    if dropout_rate > 0.0 and key is not None:
+        ka, km = jax.random.split(key)
+        a = _dropout(a, dropout_rate, ka)
+    x = x + a
+    return x, _layer_norm(x, p["ln2s"], p["ln2b"]), km
+
+
 def block_apply(p, x, *, heads, causal=False, dropout_rate=0.0, key=None,
                 attn=dense_attention):
     """One pre-LN encoder block from a dict of per-layer params.
@@ -94,20 +117,12 @@ def block_apply(p, x, *, heads, causal=False, dropout_rate=0.0, key=None,
     autoregressive mask. ``attn`` is the core from
     :func:`resolve_block_cores` (dense, or the flash kernel variant
     matching the calling context)."""
-    mb, t, c = x.shape
-    y = _layer_norm(x, p["ln1s"], p["ln1b"])
-    qkv = y @ p["qkv_k"] + p["qkv_b"]
-    qkv = qkv.reshape(mb, t, 3, heads, c // heads)
-    a = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=causal)
-    a = a.reshape(mb, t, c) @ p["out_k"] + p["out_b"]
-    if dropout_rate > 0.0 and key is not None:
-        ka, km = jax.random.split(key)
-        a = _dropout(a, dropout_rate, ka)
-    x = x + a
-    y = _layer_norm(x, p["ln2s"], p["ln2b"])
+    x, y, km = attn_half_apply(p, x, heads=heads, causal=causal,
+                               dropout_rate=dropout_rate, key=key,
+                               attn=attn)
     h = nn.gelu(y @ p["fc1_k"] + p["fc1_b"])
     h = h @ p["fc2_k"] + p["fc2_b"]
-    if dropout_rate > 0.0 and key is not None:
+    if dropout_rate > 0.0 and km is not None:
         h = _dropout(h, dropout_rate, km)
     return x + h
 
@@ -229,7 +244,9 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
             "family's (lm/lm_pp ulysses|ring) — a 64-token patch grid "
             "has nothing to shard")
     if cfg.moe_experts > 0:
-        raise ValueError("vit_pp does not support MoE blocks")
+        raise ValueError("vit_pp does not support MoE blocks (the "
+                         "MoE x PP composition lives in the LM "
+                         "family: --model lm_pp --moe-experts N)")
     if cfg.pp_schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}; "
                          "expected gpipe|1f1b")

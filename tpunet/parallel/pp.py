@@ -33,7 +33,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def gpipe(stage_apply: Callable, stacked_params, x, *,
           mesh: Mesh, n_micro: int, axis_name: str = "pipe",
-          data_axis: str = "data", seq_axis: str = None, key=None):
+          data_axis: str = "data", seq_axis: str = None, key=None,
+          with_aux: bool = False):
     """Run ``x`` through all pipeline stages.
 
     stage_apply(local_params, x_micro) applies one stage's layer stack
@@ -54,6 +55,17 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     ppermute rotations, tpunet/models/lm_pp.py). Executor logic is
     untouched: microbatching, ppermute hops and buffers all act on
     the batch dim only.
+
+    ``with_aux`` (MoE x PP): stage_apply then returns ``(y, aux)``
+    with ``aux`` a float32 scalar per (stage, microbatch) — e.g. the
+    MoE load-balance term of the stage's layers — and the executor
+    returns ``(out, aux_total)`` where ``aux_total`` is the SUM over
+    stages and the MEAN over microbatches and data/seq shards
+    (matching the equal-weight semantics gradient accumulation uses
+    for count-independent loss terms, tpunet/train/steps.py). With
+    pipe > 1 each microbatch-shard routes its tokens independently —
+    per-shard stats, the standard shard_map MoE scope — whereas
+    pipe == 1 routes the full global batch like the unpipelined model.
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -64,26 +76,32 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
 
     p_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     x_spec = P(data_axis, seq_axis, None)
+    out_specs = (x_spec, P()) if with_aux else x_spec
 
     if key is None:
         body = functools.partial(_gpipe_body, stage_apply,
-                                 n_micro=n_micro, axis_name=axis_name)
+                                 n_micro=n_micro, axis_name=axis_name,
+                                 data_axis=data_axis, seq_axis=seq_axis,
+                                 with_aux=with_aux)
         in_specs = (p_specs, x_spec)
         args = (stacked_params, x)
     else:
         body = functools.partial(_gpipe_body_keyed, stage_apply,
-                                 n_micro=n_micro, axis_name=axis_name)
+                                 n_micro=n_micro, axis_name=axis_name,
+                                 data_axis=data_axis, seq_axis=seq_axis,
+                                 with_aux=with_aux)
         in_specs = (p_specs, x_spec, P())      # key replicated
         args = (stacked_params, x, key)
 
     fn = jax.shard_map(
-        body, mesh=mesh, in_specs=in_specs, out_specs=x_spec,
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False)
     return fn(*args)
 
 
 def _gpipe_body_keyed(stage_apply, local_params, xl, key, *, n_micro,
-                      axis_name):
+                      axis_name, data_axis="data", seq_axis=None,
+                      with_aux=False):
     """_gpipe_body with a per-(tick, stage) folded PRNG key."""
     s = jax.lax.axis_index(axis_name)
 
@@ -93,10 +111,22 @@ def _gpipe_body_keyed(stage_apply, local_params, xl, key, *, n_micro,
                                                                  step), s))
 
     return _gpipe_body(keyed_apply, local_params, xl, n_micro=n_micro,
-                       axis_name=axis_name, pass_step=True)
+                       axis_name=axis_name, data_axis=data_axis,
+                       seq_axis=seq_axis, with_aux=with_aux,
+                       pass_step=True)
+
+
+def _shard_norm(data_axis, seq_axis):
+    """(grad/aux normalization axes, shard count over them)."""
+    axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
+    n = 1
+    for ax in axes:
+        n = n * jax.lax.psum(1, ax)
+    return axes, n
 
 
 def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
+                data_axis="data", seq_axis=None, with_aux=False,
                 pass_step=False):
     s = jax.lax.axis_index(axis_name)
     n_stages = jax.lax.psum(1, axis_name)
@@ -109,7 +139,7 @@ def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
     perm = [(i, i + 1) for i in range(n_stages - 1)]  # no wraparound
 
     def tick(carry, step):
-        act_in, outbuf = carry
+        act_in, outbuf, auxsum = carry
         m = step - s
         valid = (m >= 0) & (m < n_micro)
         mc = jnp.clip(m, 0, n_micro - 1)
@@ -119,6 +149,10 @@ def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
                         act_in)
         y = (stage_apply(local_params, inp, step) if pass_step
              else stage_apply(local_params, inp))
+        if with_aux:
+            y, a = y
+            auxsum = auxsum + jnp.where(valid,
+                                        a.astype(jnp.float32), 0.0)
         y = jnp.where(valid, y, jnp.zeros_like(y))
         is_last = s == n_stages - 1
         outbuf = jax.lax.dynamic_update_index_in_dim(
@@ -128,17 +162,25 @@ def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
                                                    keepdims=False)),
             mc, 0)
         act_next = jax.lax.ppermute(y, axis_name, perm)
-        return (act_next, outbuf), None
+        return (act_next, outbuf, auxsum), None
 
     act0 = jnp.zeros((mb, t, c), xl.dtype)
     outbuf = jnp.zeros_like(xm)
-    (_, outbuf), _ = jax.lax.scan(
-        tick, (act0, outbuf), jnp.arange(n_micro + n_stages - 1))
+    (_, outbuf, auxsum), _ = jax.lax.scan(
+        tick, (act0, outbuf, jnp.zeros((), jnp.float32)),
+        jnp.arange(n_micro + n_stages - 1))
     # Only the last stage wrote real activations; psum replicates them.
     outbuf = jax.lax.psum(
         jnp.where(s == n_stages - 1, outbuf, jnp.zeros_like(outbuf)),
         axis_name)
-    return outbuf.reshape(bl, t, c)
+    out = outbuf.reshape(bl, t, c)
+    if not with_aux:
+        return out
+    # Sum over stages ('pipe' psum), mean over microbatches and
+    # data/seq shards (each routed its tokens independently).
+    norm_axes, n_shards = _shard_norm(data_axis, seq_axis)
+    aux = jax.lax.psum(jax.lax.psum(auxsum, axis_name), norm_axes)
+    return out, aux / (n_micro * n_shards)
 
 
 # ---------------------------------------------------------------------------
@@ -179,7 +221,8 @@ def onef1b_schedule(n_stages: int, n_micro: int) -> list:
 
 def onef1b(stage_apply: Callable, stacked_params, x, *,
            mesh: Mesh, n_micro: int, axis_name: str = "pipe",
-           data_axis: str = "data", seq_axis: str = None, key=None):
+           data_axis: str = "data", seq_axis: str = None, key=None,
+           with_aux: bool = False):
     """GPipe-compatible pipeline executor with a manual VJP whose
     backward runs the 1F1B schedule.
 
@@ -213,7 +256,10 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     collective sequence identical on every device every tick
     (branch-divergent in-stage collectives measurably corrupt
     gradients — see the body comment). Double differentiation is not
-    supported (custom_vjp).
+    supported (custom_vjp). ``with_aux`` matches :func:`gpipe`'s
+    contract: stage_apply returns (y, aux); the executor returns
+    (out, aux_total) and the manual backward pulls the aux cotangent
+    through the same per-tick vjp as the activation cotangent.
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -227,28 +273,36 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     keyed = key is not None
     kk = key if keyed else jnp.zeros((2,), jnp.uint32)
 
+    fwd_out_specs = (x_spec, P()) if with_aux else x_spec
+
     def fwd_program(params, xx, k):
         if keyed:
             body = functools.partial(_gpipe_body_keyed, stage_apply,
-                                     n_micro=n_micro, axis_name=axis_name)
+                                     n_micro=n_micro, axis_name=axis_name,
+                                     data_axis=data_axis,
+                                     seq_axis=seq_axis, with_aux=with_aux)
             return jax.shard_map(
                 body, mesh=mesh, in_specs=(p_specs, x_spec, P()),
-                out_specs=x_spec, check_vma=False)(params, xx, k)
+                out_specs=fwd_out_specs, check_vma=False)(params, xx, k)
         body = functools.partial(_gpipe_body, stage_apply,
-                                 n_micro=n_micro, axis_name=axis_name)
+                                 n_micro=n_micro, axis_name=axis_name,
+                                 data_axis=data_axis, seq_axis=seq_axis,
+                                 with_aux=with_aux)
         return jax.shard_map(
             body, mesh=mesh, in_specs=(p_specs, x_spec),
-            out_specs=x_spec, check_vma=False)(params, xx)
+            out_specs=fwd_out_specs, check_vma=False)(params, xx)
 
-    def bwd_program(params, xx, k, dy):
+    def bwd_program(params, xx, k, dy, daux):
         body = functools.partial(_onef1b_bwd_body, stage_apply,
                                  n_micro=n_micro, axis_name=axis_name,
                                  data_axis=data_axis, seq_axis=seq_axis,
-                                 n_stages=n_stages, keyed=keyed)
+                                 n_stages=n_stages, keyed=keyed,
+                                 with_aux=with_aux)
         return jax.shard_map(
-            body, mesh=mesh, in_specs=(p_specs, x_spec, P(), x_spec),
+            body, mesh=mesh,
+            in_specs=(p_specs, x_spec, P(), x_spec, P()),
             out_specs=(p_specs, x_spec), check_vma=False)(
-                params, xx, k, dy)
+                params, xx, k, dy, daux)
 
     @jax.custom_vjp
     def run(params, xx, k):
@@ -257,9 +311,14 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     def run_fwd(params, xx, k):
         return fwd_program(params, xx, k), (params, xx, k)
 
-    def run_bwd(res, dy):
+    def run_bwd(res, ct):
         params, xx, k = res
-        dparams, dx = bwd_program(params, xx, k, dy)
+        if with_aux:
+            dy, daux = ct
+        else:
+            dy, daux = ct, jnp.zeros((), jnp.float32)
+        dparams, dx = bwd_program(params, xx, k, dy,
+                                  daux.astype(jnp.float32))
         # PRNG keys are integer-typed: their cotangent type is float0.
         dk = np.zeros(np.shape(k), dtype=jax.dtypes.float0)
         return dparams, dx, dk
@@ -268,9 +327,9 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     return run(stacked_params, x, kk)
 
 
-def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
-                     n_micro, axis_name, data_axis, seq_axis, n_stages,
-                     keyed):
+def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, dauxl=None,
+                     *, n_micro, axis_name, data_axis, seq_axis,
+                     n_stages, keyed, with_aux=False):
     """Device-local 1F1B backward: one scan over 2(M+S-1) ticks.
 
     Carry: (act_in, cot_in, resid ring, dparam accumulator fp32,
@@ -280,7 +339,10 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
     accumulate dparams, ship the input-cotangent up), or idle
     (masked). F/B tick parities differ per stage (onef1b_schedule), so
     one ``lax.cond`` picks the work; both ppermutes run unconditionally
-    with masked zeros.
+    with masked zeros. With ``with_aux`` each B-tick's vjp also pulls
+    the executor-level aux cotangent ``daux / (M * n_shards)`` — the
+    transpose of the forward's sum-over-stages / mean-over-
+    microbatch-shards aux reduction (:func:`_gpipe_body`).
     """
     s = jax.lax.axis_index(axis_name)
     S, M = n_stages, n_micro
@@ -291,6 +353,9 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
     mb = bl // M
     xm = xl.reshape(M, mb, t, c)
     dym = dyl.reshape(M, mb, t, c)
+    if with_aux:
+        _, n_shards = _shard_norm(data_axis, seq_axis)
+        aux_ct = dauxl.astype(jnp.float32) / (M * n_shards)
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     rev_perm = [(i + 1, i) for i in range(S - 1)]
     n_buf = min(S, M)   # 1F1B in-flight bound (residency at stage s
@@ -346,7 +411,11 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
             inp = jnp.where(f_valid, f_inp, b_inp)
             y, pull = jax.vjp(lambda p, xi: apply_f(p, xi, m_sel),
                               local_params, inp)
-            dp, dx = pull(g_in)
+            if with_aux:
+                y, _ = y
+                dp, dx = pull((g_in, aux_ct))
+            else:
+                dp, dx = pull(g_in)
         else:
             # No seq sharding -> stage bodies are collective-free and
             # the cheap schedule runs only the branch each tick needs.
@@ -355,15 +424,18 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, *,
 
             def do_f(_):
                 yf = apply_f(local_params, f_inp, m_fc)
+                if with_aux:
+                    yf = yf[0]
                 return yf, jnp.zeros_like(f_inp), zero_dp
 
             def do_b(_):
                 # Recompute this stage's forward and pull the cotangent
                 # back through it — idle ticks also land here on zeros,
-                # masked out below.
+                # masked out below (dp/dx are b_valid-masked, so the
+                # unmasked aux cotangent never leaks from idle ticks).
                 _, pull = jax.vjp(lambda p, xi: apply_f(p, xi, m_bc),
                                   local_params, b_inp)
-                dpb, dxb = pull(g_in)
+                dpb, dxb = pull((g_in, aux_ct) if with_aux else g_in)
                 return jnp.zeros_like(f_inp), dxb, dpb
 
             y, dx, dp = jax.lax.cond(f_valid, do_f, do_b, None)
