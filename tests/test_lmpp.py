@@ -498,7 +498,8 @@ def test_lmpp_moe_trains_and_serves(tmp_path, capsys):
               "lm_pp", "--prompt", "5 7 3", "--tokens", "5",
               "--vit-hidden", "64", "--vit-depth", "4", "--vit-heads",
               "4", "--vocab-size", "32", "--max-seq-len", "32",
-              "--moe-experts", "4", "--moe-every", "2"])
+              "--moe-experts", "4", "--moe-every", "2",
+              "--moe-capacity-factor", "2.0"])
     out = capsys.readouterr().out.strip().splitlines()[-1].split()
     assert out[:3] == ["5", "7", "3"] and len(out) == 8
     assert all(0 <= int(t) < 32 for t in out)

@@ -30,6 +30,13 @@ through gpipe, folded per (tick, stage, layer). Grad accumulation
 composes too — the accumulation scan in steps.py wraps the whole
 pipelined program (microbatching in TIME over microbatching in STAGES).
 
+Packed sequences compose: ``segment_ids`` travel as the executors'
+per-microbatch ``extra`` input (each stage indexes its current
+microbatch's ids — batch metadata never hops), masking attention to
+same-segment tokens inside every block; ``--pack-docs --model lm_pp``
+works under both schedules (SP attention excluded: no segment-capable
+SP core).
+
 MoE composes as well (EP x PP): with ``--moe-experts`` the stacks are
 organized as SUPER-layers — ``moe_every - 1`` dense blocks plus one
 routed block per scan step — so the per-stage program stays one
@@ -116,7 +123,8 @@ _MOE_KEYS = ("rk", "rb", "wi", "bi", "wo", "bo")
 
 
 def _moe_block_apply(pa, pm, x, *, heads, top_k, capacity_factor,
-                     dropout_rate=0.0, key=None, attn):
+                     dropout_rate=0.0, key=None, attn,
+                     segment_ids=None):
     """One pre-LN block whose MLP is the routed MoE core: the shared
     attention half (vit_pp.attn_half_apply — same dropout placements
     and key split as dense blocks), then moe_apply
@@ -126,7 +134,7 @@ def _moe_block_apply(pa, pm, x, *, heads, top_k, capacity_factor,
     mb, t, c = x.shape
     x, y, km = attn_half_apply(pa, x, heads=heads, causal=True,
                                dropout_rate=dropout_rate, key=key,
-                               attn=attn)
+                               attn=attn, segment_ids=segment_ids)
     tokens = y.reshape(mb * t, c)
     logits = (tokens.astype(jnp.float32) @ pm["rk"].astype(jnp.float32)
               + pm["rb"].astype(jnp.float32))
@@ -165,10 +173,20 @@ class PipelinedLM(nn.Module):
     input_kind = "tokens"              # init_variables dispatch
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, segment_ids=None):
+        """``segment_ids`` [B, T] enables packed-sequence training:
+        attention masks to same-segment tokens (composed with
+        causality in the core). The ids travel through the pipeline as
+        the executors' non-differentiable ``extra`` input — indexed
+        per microbatch by each stage, never hopped."""
         if self.hidden % self.heads:
             raise ValueError(f"hidden {self.hidden} not divisible by "
                              f"{self.heads} heads")
+        packed = segment_ids is not None
+        if packed and self.attention in ("ulysses", "ring"):
+            raise ValueError(
+                f"packed sequences need a segment-capable attention "
+                f"core (dense/flash/auto), got {self.attention!r}")
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -299,7 +317,15 @@ class PipelinedLM(nn.Module):
 
         top_k, cap_f = self.moe_top_k, self.moe_capacity_factor
 
-        def stage_apply(params, xs, k=None):
+        def stage_apply(params, xs, *rest):
+            # rest per the executor protocol: (extra?, key?) — extra is
+            # this microbatch's [mb, T] segment-id slice when packed.
+            if packed:
+                seg_pair = (rest[0], rest[0])
+                rest = rest[1:]
+            else:
+                seg_pair = None
+            k = rest[0] if rest else None
             if k is not None and sp_in_pipe:
                 # x is seq-sharded inside the pipeline under SP
                 # (ulysses or ring): without this fold every
@@ -317,7 +343,8 @@ class PipelinedLM(nn.Module):
                           else None)
                     return block_apply(pl, carry, heads=heads,
                                        causal=True, dropout_rate=rate,
-                                       key=lk, attn=attn), None
+                                       key=lk, attn=attn,
+                                       segment_ids=seg_pair), None
                 idx = jnp.arange(
                     jax.tree_util.tree_leaves(params)[0].shape[0])
                 out, _ = jax.lax.scan(body, xs, (params, idx))
@@ -347,7 +374,7 @@ class PipelinedLM(nn.Module):
                           if k is not None else None)
                     xc = block_apply(pl, xc, heads=heads, causal=True,
                                      dropout_rate=rate, key=lk,
-                                     attn=attn)
+                                     attn=attn, segment_ids=seg_pair)
                 pl = {kk: pa_g[kk][m_every - 1] for kk in _ATTN_KEYS}
                 lk = (jax.random.fold_in(k, g * m_every + m_every - 1)
                       if k is not None else None)
@@ -355,7 +382,8 @@ class PipelinedLM(nn.Module):
                                          top_k=top_k,
                                          capacity_factor=cap_f,
                                          dropout_rate=rate, key=lk,
-                                         attn=attn)
+                                         attn=attn,
+                                         segment_ids=seg_pair)
                 return (xc, auxc + a), None
 
             (out, aux), _ = jax.lax.scan(
@@ -368,10 +396,11 @@ class PipelinedLM(nn.Module):
             x = executor(stage_apply, blocks, x, mesh=self.mesh,
                          n_micro=self.n_micro, key=key,
                          seq_axis="seq" if sp else None,
-                         with_aux=moe)
+                         with_aux=moe, extra=segment_ids)
         else:
-            x = (stage_apply(blocks, x) if key is None
-                 else stage_apply(blocks, x, key))
+            args = (x,) if segment_ids is None else (x, segment_ids)
+            x = (stage_apply(blocks, *args) if key is None
+                 else stage_apply(blocks, *args, key))
         if moe:
             # One scalar for the whole program: sum over layers, and
             # with pipe > 1 the executor's mean over microbatch-shards

@@ -84,19 +84,26 @@ def _dropout(x, rate, key):
 
 
 def attn_half_apply(p, x, *, heads, causal=False, dropout_rate=0.0,
-                    key=None, attn=dense_attention):
+                    key=None, attn=dense_attention, segment_ids=None):
     """The attention half of a pre-LN block: ln1 -> qkv -> ``attn`` ->
     out-projection -> dropout -> residual, then ln2. Returns
     ``(x_resid, y_ln2, mlp_key)`` — the post-residual activations, the
     ln2 output feeding whichever MLP follows (dense fc pair or the MoE
     core), and the second half of the dropout key split (None when
     dropout is off), so both block kinds share one dropout placement
-    and key-split convention."""
+    and key-split convention. ``segment_ids`` (packed sequences): a
+    (q_seg, kv_seg) pair forwarded to segment-capable cores only when
+    given, so SP closures without the kwarg stay usable."""
     mb, t, c = x.shape
     y = _layer_norm(x, p["ln1s"], p["ln1b"])
     qkv = y @ p["qkv_k"] + p["qkv_b"]
     qkv = qkv.reshape(mb, t, 3, heads, c // heads)
-    a = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=causal)
+    if segment_ids is None:
+        a = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                 causal=causal)
+    else:
+        a = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                 causal=causal, segment_ids=segment_ids)
     a = a.reshape(mb, t, c) @ p["out_k"] + p["out_b"]
     km = None
     if dropout_rate > 0.0 and key is not None:
@@ -107,7 +114,7 @@ def attn_half_apply(p, x, *, heads, causal=False, dropout_rate=0.0,
 
 
 def block_apply(p, x, *, heads, causal=False, dropout_rate=0.0, key=None,
-                attn=dense_attention):
+                attn=dense_attention, segment_ids=None):
     """One pre-LN encoder block from a dict of per-layer params.
 
     Mirrors tpunet/models/vit.py's EncoderBlock: dropout (when
@@ -119,7 +126,7 @@ def block_apply(p, x, *, heads, causal=False, dropout_rate=0.0, key=None,
     matching the calling context)."""
     x, y, km = attn_half_apply(p, x, heads=heads, causal=causal,
                                dropout_rate=dropout_rate, key=key,
-                               attn=attn)
+                               attn=attn, segment_ids=segment_ids)
     h = nn.gelu(y @ p["fc1_k"] + p["fc1_b"])
     h = h @ p["fc2_k"] + p["fc2_b"]
     if dropout_rate > 0.0 and km is not None:

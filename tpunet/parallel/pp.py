@@ -34,7 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 def gpipe(stage_apply: Callable, stacked_params, x, *,
           mesh: Mesh, n_micro: int, axis_name: str = "pipe",
           data_axis: str = "data", seq_axis: str = None, key=None,
-          with_aux: bool = False):
+          with_aux: bool = False, extra=None):
     """Run ``x`` through all pipeline stages.
 
     stage_apply(local_params, x_micro) applies one stage's layer stack
@@ -66,32 +66,40 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     pipe > 1 each microbatch-shard routes its tokens independently —
     per-shard stats, the standard shard_map MoE scope — whereas
     pipe == 1 routes the full global batch like the unpipelined model.
+
+    ``extra`` (packed x PP): an optional per-example array [B, ...]
+    (e.g. packed-sequence segment ids) microbatched alongside ``x``.
+    It does NOT hop between stages: it is batch-constant metadata,
+    replicated over 'pipe', so every stage just indexes its current
+    microbatch's slice. Stage protocol becomes
+    ``stage_apply(params, x_micro, extra_micro[, key])``.
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
-        return (stage_apply(stacked_params, x) if key is None
-                else stage_apply(stacked_params, x, key))
+        args = ((x,) if extra is None else (x, extra))
+        return (stage_apply(stacked_params, *args) if key is None
+                else stage_apply(stacked_params, *args, key))
 
     _check_stacked(stacked_params, n_stages)
 
     p_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     x_spec = P(data_axis, seq_axis, None)
     out_specs = (x_spec, P()) if with_aux else x_spec
+    has_extra = extra is not None
+    e_spec = P(data_axis, seq_axis) if has_extra else None
 
+    kw = dict(n_micro=n_micro, axis_name=axis_name, data_axis=data_axis,
+              seq_axis=seq_axis, with_aux=with_aux, has_extra=has_extra)
     if key is None:
-        body = functools.partial(_gpipe_body, stage_apply,
-                                 n_micro=n_micro, axis_name=axis_name,
-                                 data_axis=data_axis, seq_axis=seq_axis,
-                                 with_aux=with_aux)
-        in_specs = (p_specs, x_spec)
-        args = (stacked_params, x)
+        body = functools.partial(_gpipe_body, stage_apply, **kw)
+        in_specs = (p_specs, x_spec) + ((e_spec,) if has_extra else ())
+        args = (stacked_params, x) + ((extra,) if has_extra else ())
     else:
-        body = functools.partial(_gpipe_body_keyed, stage_apply,
-                                 n_micro=n_micro, axis_name=axis_name,
-                                 data_axis=data_axis, seq_axis=seq_axis,
-                                 with_aux=with_aux)
-        in_specs = (p_specs, x_spec, P())      # key replicated
-        args = (stacked_params, x, key)
+        body = functools.partial(_gpipe_body_keyed, stage_apply, **kw)
+        in_specs = ((p_specs, x_spec)
+                    + ((e_spec,) if has_extra else ()) + (P(),))
+        args = ((stacked_params, x)
+                + ((extra,) if has_extra else ()) + (key,))
 
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -99,20 +107,26 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     return fn(*args)
 
 
-def _gpipe_body_keyed(stage_apply, local_params, xl, key, *, n_micro,
+def _gpipe_body_keyed(stage_apply, local_params, xl, *rest, n_micro,
                       axis_name, data_axis="data", seq_axis=None,
-                      with_aux=False):
-    """_gpipe_body with a per-(tick, stage) folded PRNG key."""
+                      with_aux=False, has_extra=False):
+    """_gpipe_body with a per-(tick, stage) folded PRNG key (always
+    the LAST positional arg; an ``extra`` slice precedes it when
+    present — see :func:`gpipe`'s stage protocol)."""
+    key = rest[-1]
     s = jax.lax.axis_index(axis_name)
 
-    def keyed_apply(params, x, step):
-        return stage_apply(params, x,
-                           jax.random.fold_in(jax.random.fold_in(key,
-                                                                 step), s))
+    def keyed_apply(params, x, *inner):
+        # inner = (extra_micro?, step): fold the tick into the key and
+        # forward everything but the step to the user's stage_apply.
+        step = inner[-1]
+        k = jax.random.fold_in(jax.random.fold_in(key, step), s)
+        return stage_apply(params, x, *inner[:-1], k)
 
-    return _gpipe_body(keyed_apply, local_params, xl, n_micro=n_micro,
-                       axis_name=axis_name, data_axis=data_axis,
-                       seq_axis=seq_axis, with_aux=with_aux,
+    return _gpipe_body(keyed_apply, local_params, xl, *rest[:-1],
+                       n_micro=n_micro, axis_name=axis_name,
+                       data_axis=data_axis, seq_axis=seq_axis,
+                       with_aux=with_aux, has_extra=has_extra,
                        pass_step=True)
 
 
@@ -125,9 +139,10 @@ def _shard_norm(data_axis, seq_axis):
     return axes, n
 
 
-def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
+def _gpipe_body(stage_apply, local_params, xl, *rest, n_micro, axis_name,
                 data_axis="data", seq_axis=None, with_aux=False,
-                pass_step=False):
+                has_extra=False, pass_step=False):
+    extra = rest[0] if has_extra else None
     s = jax.lax.axis_index(axis_name)
     n_stages = jax.lax.psum(1, axis_name)
     bl, t, c = xl.shape
@@ -136,6 +151,8 @@ def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
                          f"{n_micro} microbatches")
     mb = bl // n_micro
     xm = xl.reshape(n_micro, mb, t, c)
+    em = (extra.reshape((n_micro, mb) + extra.shape[1:])
+          if has_extra else None)
     perm = [(i, i + 1) for i in range(n_stages - 1)]  # no wraparound
 
     def tick(carry, step):
@@ -147,8 +164,12 @@ def _gpipe_body(stage_apply, local_params, xl, *, n_micro, axis_name,
                         jax.lax.dynamic_index_in_dim(xm, mc, 0,
                                                      keepdims=False),
                         act_in)
-        y = (stage_apply(local_params, inp, step) if pass_step
-             else stage_apply(local_params, inp))
+        args = (local_params, inp)
+        if has_extra:
+            args += (jax.lax.dynamic_index_in_dim(em, mc, 0,
+                                                  keepdims=False),)
+        y = (stage_apply(*args, step) if pass_step
+             else stage_apply(*args))
         if with_aux:
             y, a = y
             auxsum = auxsum + jnp.where(valid,
@@ -222,7 +243,7 @@ def onef1b_schedule(n_stages: int, n_micro: int) -> list:
 def onef1b(stage_apply: Callable, stacked_params, x, *,
            mesh: Mesh, n_micro: int, axis_name: str = "pipe",
            data_axis: str = "data", seq_axis: str = None, key=None,
-           with_aux: bool = False):
+           with_aux: bool = False, extra=None):
     """GPipe-compatible pipeline executor with a manual VJP whose
     backward runs the 1F1B schedule.
 
@@ -260,11 +281,16 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     contract: stage_apply returns (y, aux); the executor returns
     (out, aux_total) and the manual backward pulls the aux cotangent
     through the same per-tick vjp as the activation cotangent.
+    ``extra`` matches gpipe's contract too (per-microbatch metadata,
+    e.g. packed segment ids) and is treated as NON-differentiable —
+    its cotangent is zero.
     """
     n_stages = mesh.shape[axis_name]
+    has_extra = extra is not None
     if n_stages == 1:
-        return (stage_apply(stacked_params, x) if key is None
-                else stage_apply(stacked_params, x, key))
+        args = ((x,) if extra is None else (x, extra))
+        return (stage_apply(stacked_params, *args) if key is None
+                else stage_apply(stacked_params, *args, key))
     _check_stacked(stacked_params, n_stages)
 
     p_specs = jax.tree_util.tree_map(lambda _: P(axis_name),
@@ -272,64 +298,70 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     x_spec = P(data_axis, seq_axis, None)
     keyed = key is not None
     kk = key if keyed else jnp.zeros((2,), jnp.uint32)
+    # Fixed custom_vjp arity: a zero-size placeholder when no extra.
+    ex = extra if has_extra else jnp.zeros((0,), jnp.int32)
+    e_spec = P(data_axis, seq_axis) if has_extra else P()
 
     fwd_out_specs = (x_spec, P()) if with_aux else x_spec
+    kw = dict(n_micro=n_micro, axis_name=axis_name, data_axis=data_axis,
+              seq_axis=seq_axis, with_aux=with_aux, has_extra=has_extra)
 
-    def fwd_program(params, xx, k):
+    def fwd_program(params, xx, exx, k):
+        e_args = (exx,) if has_extra else ()
+        e_in = (e_spec,) if has_extra else ()
         if keyed:
             body = functools.partial(_gpipe_body_keyed, stage_apply,
-                                     n_micro=n_micro, axis_name=axis_name,
-                                     data_axis=data_axis,
-                                     seq_axis=seq_axis, with_aux=with_aux)
+                                     **kw)
             return jax.shard_map(
-                body, mesh=mesh, in_specs=(p_specs, x_spec, P()),
-                out_specs=fwd_out_specs, check_vma=False)(params, xx, k)
-        body = functools.partial(_gpipe_body, stage_apply,
-                                 n_micro=n_micro, axis_name=axis_name,
-                                 data_axis=data_axis, seq_axis=seq_axis,
-                                 with_aux=with_aux)
+                body, mesh=mesh,
+                in_specs=(p_specs, x_spec) + e_in + (P(),),
+                out_specs=fwd_out_specs, check_vma=False)(
+                    params, xx, *e_args, k)
+        body = functools.partial(_gpipe_body, stage_apply, **kw)
         return jax.shard_map(
-            body, mesh=mesh, in_specs=(p_specs, x_spec),
-            out_specs=fwd_out_specs, check_vma=False)(params, xx)
+            body, mesh=mesh, in_specs=(p_specs, x_spec) + e_in,
+            out_specs=fwd_out_specs, check_vma=False)(
+                params, xx, *e_args)
 
-    def bwd_program(params, xx, k, dy, daux):
+    def bwd_program(params, xx, exx, k, dy, daux):
         body = functools.partial(_onef1b_bwd_body, stage_apply,
-                                 n_micro=n_micro, axis_name=axis_name,
-                                 data_axis=data_axis, seq_axis=seq_axis,
-                                 n_stages=n_stages, keyed=keyed,
-                                 with_aux=with_aux)
+                                 n_stages=n_stages, keyed=keyed, **kw)
         return jax.shard_map(
             body, mesh=mesh,
-            in_specs=(p_specs, x_spec, P(), x_spec, P()),
+            in_specs=(p_specs, x_spec, e_spec, P(), x_spec, P()),
             out_specs=(p_specs, x_spec), check_vma=False)(
-                params, xx, k, dy, daux)
+                params, xx, exx, k, dy, daux)
 
     @jax.custom_vjp
-    def run(params, xx, k):
-        return fwd_program(params, xx, k)
+    def run(params, xx, exx, k):
+        return fwd_program(params, xx, exx, k)
 
-    def run_fwd(params, xx, k):
-        return fwd_program(params, xx, k), (params, xx, k)
+    def run_fwd(params, xx, exx, k):
+        return fwd_program(params, xx, exx, k), (params, xx, exx, k)
 
     def run_bwd(res, ct):
-        params, xx, k = res
+        params, xx, exx, k = res
         if with_aux:
             dy, daux = ct
         else:
             dy, daux = ct, jnp.zeros((), jnp.float32)
-        dparams, dx = bwd_program(params, xx, k, dy,
+        dparams, dx = bwd_program(params, xx, exx, k, dy,
                                   daux.astype(jnp.float32))
-        # PRNG keys are integer-typed: their cotangent type is float0.
+        # PRNG keys and (integer) extras have float0 cotangents.
         dk = np.zeros(np.shape(k), dtype=jax.dtypes.float0)
-        return dparams, dx, dk
+        dex = (np.zeros(np.shape(exx), dtype=jax.dtypes.float0)
+               if jnp.issubdtype(exx.dtype, jnp.integer)
+               else jnp.zeros_like(exx))
+        return dparams, dx, dex, dk
 
     run.defvjp(run_fwd, run_bwd)
-    return run(stacked_params, x, kk)
+    return run(stacked_params, x, ex, kk)
 
 
-def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, dauxl=None,
-                     *, n_micro, axis_name, data_axis, seq_axis,
-                     n_stages, keyed, with_aux=False):
+def _onef1b_bwd_body(stage_apply, local_params, xl, exl, key, dyl,
+                     dauxl=None, *, n_micro, axis_name, data_axis,
+                     seq_axis, n_stages, keyed, with_aux=False,
+                     has_extra=False):
     """Device-local 1F1B backward: one scan over 2(M+S-1) ticks.
 
     Carry: (act_in, cot_in, resid ring, dparam accumulator fp32,
@@ -353,6 +385,7 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, dauxl=None,
     mb = bl // M
     xm = xl.reshape(M, mb, t, c)
     dym = dyl.reshape(M, mb, t, c)
+    exm = (exl.reshape((M, mb) + exl.shape[1:]) if has_extra else None)
     if with_aux:
         _, n_shards = _shard_norm(data_axis, seq_axis)
         aux_ct = dauxl.astype(jnp.float32) / (M * n_shards)
@@ -363,12 +396,16 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, key, dyl, dauxl=None,
     #                     in tests/test_pp_1f1b.py)
 
     def apply_f(params, inp, m):
+        args = (params, inp)
+        if has_extra:
+            args += (jax.lax.dynamic_index_in_dim(exm, m, 0,
+                                                  keepdims=False),)
         if keyed:
             # EXACTLY _gpipe_body_keyed's folding — fwd tick = m + s —
             # so replayed dropout masks match the primal bit-for-bit.
             k = jax.random.fold_in(jax.random.fold_in(key, m + s), s)
-            return stage_apply(params, inp, k)
-        return stage_apply(params, inp)
+            return stage_apply(*args, k)
+        return stage_apply(*args)
 
     def tick(carry, t_):
         act_in, cot_in, resid, dpsum, dxbuf = carry

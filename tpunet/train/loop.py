@@ -141,9 +141,10 @@ class Trainer:
                     f"--pack-docs packs text_lm documents; dataset is "
                     f"{cfg.data.dataset!r} (its labels are not segment "
                     "ids)")
-            if not self.is_lm or cfg.model.name != "lm":
-                raise ValueError("--pack-docs needs --model lm (the "
-                                 "segment-masked attention path)")
+            if not self.is_lm or cfg.model.name not in ("lm", "lm_pp"):
+                raise ValueError("--pack-docs needs --model lm or "
+                                 "lm_pp (the segment-masked attention "
+                                 "paths)")
             if cfg.model.attention not in ("dense", "flash", "auto"):
                 raise ValueError(
                     f"--pack-docs needs a segment-capable attention "
