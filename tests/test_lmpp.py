@@ -503,3 +503,24 @@ def test_lmpp_moe_trains_and_serves(tmp_path, capsys):
     out = capsys.readouterr().out.strip().splitlines()[-1].split()
     assert out[:3] == ["5", "7", "3"] and len(out) == 8
     assert all(0 <= int(t) < 32 for t in out)
+
+
+@pytest.mark.slow
+def test_lmpp_zero1_moment_shardings():
+    """ZeRO-1 composes with the pipeline: stacked block moments keep
+    their 'pipe' sharding (PP rules precede the ZeRO-1 catch-all),
+    while non-stacked leaves' moments (embed/pos/ln) spread over
+    'data' where divisible — the composition matrix's lm_pp x zero1
+    cell."""
+    from jax.sharding import PartitionSpec as P
+    cfg = _cfg(MeshConfig(data=2, pipe=2, zero1=True))
+    tr = Trainer(cfg)
+    try:
+        mu = tr.state.opt_state[0].mu
+        assert mu["blocks_qkv_k"].sharding.spec == P("pipe")
+        # embed [V, C] with V=32 divisible by data=2 -> data-sharded
+        assert mu["embed"]["embedding"].sharding.spec == P("data")
+        m = tr.train_one_epoch(1)
+        assert np.isfinite(m["loss"])
+    finally:
+        tr.close()

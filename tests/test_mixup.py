@@ -134,3 +134,27 @@ def test_validation():
                           vit_depth=2, vit_heads=4, dtype="float32"))
     with pytest.raises(ValueError, match=">= 0"):
         Trainer(img)
+
+
+@pytest.mark.slow
+def test_mixup_composes_with_pipelined_vit(tmp_path):
+    """Mixup's convex-label loss runs inside the jitted step for the
+    pipelined ViT too (the composition matrix's vit_pp cell)."""
+    cfg = TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic", image_size=32,
+                        batch_size=16, synthetic_train_size=32,
+                        synthetic_test_size=16, mixup_alpha=0.4),
+        model=ModelConfig(name="vit_pp", vit_patch=4, vit_hidden=64,
+                          vit_depth=4, vit_heads=4, dropout_rate=0.0,
+                          dtype="float32", pp_microbatches=2),
+        optim=OptimConfig(learning_rate=1e-3),
+        mesh=MeshConfig(data=2, pipe=2),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    tr = Trainer(cfg)
+    try:
+        m = tr.train_one_epoch(1)
+    finally:
+        tr.close()
+    assert np.isfinite(m["loss"])

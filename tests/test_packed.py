@@ -311,15 +311,20 @@ def test_packed_pp_matches_unpipelined_and_isolates_segments():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-6)
 
+    # Isolation in the direction only SEGMENT masking protects:
+    # mutate the EARLIER document (cols :6, segment 1) — plain causal
+    # attention would leak it into the later one; the later document's
+    # logits (cols 6:13, segment 2) must not move. (The reverse
+    # direction would pass under causality alone and prove nothing
+    # about the executors' segment plumbing.)
     m = create_model(PP_CFG, mesh=mesh)
-    toks2 = toks.at[:, 8:13].set((toks[:, 8:13] + 5) % 64)
+    toks2 = toks.at[:, :6].set((toks[:, :6] + 5) % 64)
     with mesh:
         a = m.apply(params, toks, train=False, segment_ids=segs)
         b = m.apply(params, toks2, train=False, segment_ids=segs)
-    np.testing.assert_allclose(np.asarray(a[:, :6]),
-                               np.asarray(b[:, :6]), atol=1e-6)
-    assert not np.allclose(np.asarray(a[:, 8:13]),
-                           np.asarray(b[:, 8:13]))
+    np.testing.assert_allclose(np.asarray(a[:, 6:13]),
+                               np.asarray(b[:, 6:13]), atol=1e-6)
+    assert not np.allclose(np.asarray(a[:, :6]), np.asarray(b[:, :6]))
 
 
 def test_packed_pp_validation():

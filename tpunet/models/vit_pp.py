@@ -257,6 +257,14 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
     if cfg.pp_schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}; "
                          "expected gpipe|1f1b")
+    if cfg.remat:
+        # Same contract as lm_pp: a silently-ignored memory flag is a
+        # trap — the pipeline already bounds activation memory per
+        # stage (use --pp-schedule 1f1b when the backward binds).
+        raise ValueError("vit_pp does not support --remat (the "
+                         "pipeline scan already bounds activation "
+                         "memory per stage; --pp-schedule 1f1b bounds "
+                         "the backward)")
     if mesh is not None:
         stages = mesh.shape.get("pipe", 1)
         if stages > 1 and cfg.vit_depth % stages:

@@ -141,7 +141,7 @@ class Trainer:
                     f"--pack-docs packs text_lm documents; dataset is "
                     f"{cfg.data.dataset!r} (its labels are not segment "
                     "ids)")
-            if not self.is_lm or cfg.model.name not in ("lm", "lm_pp"):
+            if not self.is_lm:
                 raise ValueError("--pack-docs needs --model lm or "
                                  "lm_pp (the segment-masked attention "
                                  "paths)")
