@@ -50,9 +50,15 @@ tpunet/train/steps.py) and is sown into the standard 'losses'
 collection. With pipe > 1 each microbatch-shard routes its tokens
 independently with per-shard capacity (the standard shard_map MoE
 scope; the unpipelined model under GSPMD routes globally — documented
-deviation, exact parity at n_micro=1). Experts are replicated within
-a stage (the expert einsums' 'model'-axis sharding applies to the
-unpipelined family only).
+deviation, exact parity at n_micro=1). With a mesh 'model' axis > 1
+the expert stacks (and their Adam moments) shard over it INSIDE the
+stages — true EP x PP: routing/dispatch replicated per shard (cheap,
+O(n x E)), expert FFNs on the local expert slice, one psum per MoE
+layer assembles the output (no token all-to-all: tokens are
+replicated over 'model'). Grad parity vs the replicated run is exact
+under both schedules; the 1F1B manual backward handles the
+unreduced-cotangent convention the in-stage psum transposes imply
+(tpunet/parallel/pp.py onef1b ep_axis).
 
 With pipe == 1 the stacked params run as a plain lax.scan over layers —
 the same math, which the parity tests assert. No KV-cache decode path
@@ -124,13 +130,15 @@ _MOE_KEYS = ("rk", "rb", "wi", "bi", "wo", "bo")
 
 def _moe_block_apply(pa, pm, x, *, heads, top_k, capacity_factor,
                      dropout_rate=0.0, key=None, attn,
-                     segment_ids=None):
+                     segment_ids=None, ep_axis=None):
     """One pre-LN block whose MLP is the routed MoE core: the shared
     attention half (vit_pp.attn_half_apply — same dropout placements
     and key split as dense blocks), then moe_apply
     (tpunet/models/moe.py) instead of the dense fc pair. Router math
     in float32 on the float32 router params (the stacked analogue of
-    MoeMlp's float32 Dense). Returns (x, aux)."""
+    MoeMlp's float32 Dense). ``ep_axis`` (EP x PP): the expert params
+    hold only this device's shard over that mesh axis; moe_apply
+    routes globally and psums the assembled output. Returns (x, aux)."""
     mb, t, c = x.shape
     x, y, km = attn_half_apply(pa, x, heads=heads, causal=True,
                                dropout_rate=dropout_rate, key=key,
@@ -140,7 +148,8 @@ def _moe_block_apply(pa, pm, x, *, heads, top_k, capacity_factor,
               + pm["rb"].astype(jnp.float32))
     out, aux = moe_apply(tokens, logits, pm["wi"], pm["bi"], pm["wo"],
                          pm["bo"], top_k=top_k,
-                         capacity_factor=capacity_factor, dtype=x.dtype)
+                         capacity_factor=capacity_factor, dtype=x.dtype,
+                         ep_axis=ep_axis)
     out = out.reshape(mb, t, c)
     if dropout_rate > 0.0 and km is not None:
         out = _dropout(out, dropout_rate, km)
@@ -316,6 +325,12 @@ class PipelinedLM(nn.Module):
         sp_in_pipe = sp and pipelined
 
         top_k, cap_f = self.moe_top_k, self.moe_capacity_factor
+        # EP x PP: shard the expert stacks over the mesh 'model' axis
+        # inside the pipeline (routing replicated, expert FFNs on the
+        # local shard, one psum per MoE layer — moe_apply's ep_axis).
+        ep_axis = ("model" if (moe and pipelined
+                               and self.mesh.shape.get("model", 1) > 1)
+                   else None)
 
         def stage_apply(params, xs, *rest):
             # rest per the executor protocol: (extra?, key?) — extra is
@@ -383,7 +398,8 @@ class PipelinedLM(nn.Module):
                                          capacity_factor=cap_f,
                                          dropout_rate=rate, key=lk,
                                          attn=attn,
-                                         segment_ids=seg_pair)
+                                         segment_ids=seg_pair,
+                                         ep_axis=ep_axis)
                 return (xc, auxc + a), None
 
             (out, aux), _ = jax.lax.scan(
@@ -393,10 +409,25 @@ class PipelinedLM(nn.Module):
 
         if pipelined:
             executor = onef1b if self.schedule == "1f1b" else gpipe
+            pspecs = None
+            kw = {}
+            if ep_axis is not None:
+                # One source of truth for the stack shardings: the
+                # same path rules the Trainer stores params under
+                # (tpunet/parallel/tp.py VIT_PP_RULES).
+                from tpunet.parallel.tp import pp_stack_spec
+                pspecs = {kk: pp_stack_spec("blocks_" + kk)
+                          for kk in blocks}
+            if self.schedule == "1f1b":
+                # the manual backward completes per-tick cotangents
+                # over the EP axis itself and resolves its own
+                # uniform_bwd from seq/ep (onef1b's ep_axis note)
+                kw["ep_axis"] = ep_axis
             x = executor(stage_apply, blocks, x, mesh=self.mesh,
                          n_micro=self.n_micro, key=key,
                          seq_axis="seq" if sp else None,
-                         with_aux=moe, extra=segment_ids)
+                         with_aux=moe, extra=segment_ids,
+                         param_specs=pspecs, **kw)
         else:
             args = (x,) if segment_ids is None else (x, segment_ids)
             x = (stage_apply(blocks, *args) if key is None
@@ -493,6 +524,12 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
                 f"{cfg.vit_depth // cfg.moe_every} MoE super-layers "
                 f"(depth {cfg.vit_depth} / moe_every {cfg.moe_every}) "
                 f"not divisible by {stages} pipeline stages")
+        ep = mesh.shape.get("model", 1) if mesh is not None else 1
+        if stages > 1 and ep > 1 and cfg.moe_experts % ep:
+            raise ValueError(
+                f"--moe-experts {cfg.moe_experts} not divisible by "
+                f"the mesh 'model' axis ({ep}) — EP x PP shards the "
+                "expert dim over it")
     if cfg.remat:
         raise ValueError("lm_pp does not support --remat (the pipeline "
                          "scan already bounds activation memory per "

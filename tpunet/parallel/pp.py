@@ -34,7 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 def gpipe(stage_apply: Callable, stacked_params, x, *,
           mesh: Mesh, n_micro: int, axis_name: str = "pipe",
           data_axis: str = "data", seq_axis: str = None, key=None,
-          with_aux: bool = False, extra=None):
+          with_aux: bool = False, extra=None, param_specs=None):
     """Run ``x`` through all pipeline stages.
 
     stage_apply(local_params, x_micro) applies one stage's layer stack
@@ -73,6 +73,12 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
     replicated over 'pipe', so every stage just indexes its current
     microbatch's slice. Stage protocol becomes
     ``stage_apply(params, x_micro, extra_micro[, key])``.
+
+    ``param_specs`` (EP x PP): an optional pytree of PartitionSpecs
+    overriding the default ``P('pipe')`` per leaf — e.g. MoE expert
+    stacks sharded ``P('pipe', 'model')`` so each device holds only
+    its expert shard; the stage body then runs its own collectives
+    over the extra axis (one psum per MoE layer in lm_pp).
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -82,7 +88,9 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
 
     _check_stacked(stacked_params, n_stages)
 
-    p_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    p_specs = (param_specs if param_specs is not None else
+               jax.tree_util.tree_map(lambda _: P(axis_name),
+                                      stacked_params))
     x_spec = P(data_axis, seq_axis, None)
     out_specs = (x_spec, P()) if with_aux else x_spec
     has_extra = extra is not None
@@ -243,7 +251,8 @@ def onef1b_schedule(n_stages: int, n_micro: int) -> list:
 def onef1b(stage_apply: Callable, stacked_params, x, *,
            mesh: Mesh, n_micro: int, axis_name: str = "pipe",
            data_axis: str = "data", seq_axis: str = None, key=None,
-           with_aux: bool = False, extra=None):
+           with_aux: bool = False, extra=None, param_specs=None,
+           uniform_bwd: bool = None, ep_axis: str = None):
     """GPipe-compatible pipeline executor with a manual VJP whose
     backward runs the 1F1B schedule.
 
@@ -283,18 +292,38 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
     through the same per-tick vjp as the activation cotangent.
     ``extra`` matches gpipe's contract too (per-microbatch metadata,
     e.g. packed segment ids) and is treated as NON-differentiable —
-    its cotangent is zero.
+    its cotangent is zero. ``param_specs`` matches gpipe's (per-leaf
+    spec override, e.g. expert stacks over ('pipe', 'model')).
+    ``uniform_bwd`` forces the collective-uniform one-vjp-per-tick
+    backward; it defaults to on exactly when ``seq_axis`` is given,
+    and callers whose stage bodies contain OTHER in-stage collectives
+    (EP's 'model' psums) must pass True themselves — in-stage
+    collectives inside the diverging F/B lax.cond corrupt gradients
+    (see the body comment). ``ep_axis`` (EP x PP): the mesh axis the
+    stage bodies' expert psums run over; the manual backward then
+    psums each tick's input-cotangent over it before shipping
+    upstream — the per-tick vjp hands back only the LOCAL expert
+    shard's cotangent paths (partial over the axis), and unlike
+    gpipe-AD (whose shard_map transpose completes them via
+    varying-manual-axes tracking) this hand-written boundary logic
+    must restore replication itself, per tick, so the NEXT stage's
+    expert-weight grads see a complete cotangent.
     """
     n_stages = mesh.shape[axis_name]
     has_extra = extra is not None
+    # In-stage collectives categorically require the uniform backward;
+    # resolve here so no caller can pass ep_axis without it.
+    uniform_bwd = (bool(uniform_bwd) or seq_axis is not None
+                   or ep_axis is not None)
     if n_stages == 1:
         args = ((x,) if extra is None else (x, extra))
         return (stage_apply(stacked_params, *args) if key is None
                 else stage_apply(stacked_params, *args, key))
     _check_stacked(stacked_params, n_stages)
 
-    p_specs = jax.tree_util.tree_map(lambda _: P(axis_name),
-                                     stacked_params)
+    p_specs = (param_specs if param_specs is not None else
+               jax.tree_util.tree_map(lambda _: P(axis_name),
+                                      stacked_params))
     x_spec = P(data_axis, seq_axis, None)
     keyed = key is not None
     kk = key if keyed else jnp.zeros((2,), jnp.uint32)
@@ -325,7 +354,10 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
 
     def bwd_program(params, xx, exx, k, dy, daux):
         body = functools.partial(_onef1b_bwd_body, stage_apply,
-                                 n_stages=n_stages, keyed=keyed, **kw)
+                                 n_stages=n_stages, keyed=keyed,
+                                 uniform_bwd=uniform_bwd,
+                                 ep_axis=ep_axis,
+                                 param_specs=p_specs, **kw)
         return jax.shard_map(
             body, mesh=mesh,
             in_specs=(p_specs, x_spec, e_spec, P(), x_spec, P()),
@@ -361,7 +393,8 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
 def _onef1b_bwd_body(stage_apply, local_params, xl, exl, key, dyl,
                      dauxl=None, *, n_micro, axis_name, data_axis,
                      seq_axis, n_stages, keyed, with_aux=False,
-                     has_extra=False):
+                     has_extra=False, uniform_bwd=False, ep_axis=None,
+                     param_specs=None):
     """Device-local 1F1B backward: one scan over 2(M+S-1) ticks.
 
     Carry: (act_in, cot_in, resid ring, dparam accumulator fp32,
@@ -386,9 +419,23 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, exl, key, dyl,
     xm = xl.reshape(M, mb, t, c)
     dym = dyl.reshape(M, mb, t, c)
     exm = (exl.reshape((M, mb) + exl.shape[1:]) if has_extra else None)
+    epn = jax.lax.psum(1, ep_axis) if ep_axis is not None else 1
+    if ep_axis is not None:
+        # In-stage EP psums put this backward in JAX's UNREDUCED
+        # cotangent convention (psum's transpose inside jax.vjp is
+        # psum — it COMPLETES a per-device partial cotangent; feeding
+        # it a complete/replicated one doubles everything downstream).
+        # Speak the convention: divide the entering cotangent by the
+        # axis size so every cotangent in the scan is an unreduced
+        # 1/ep share, then complete each result at the end — psum over
+        # ep for every leaf NOT sharded over it, and for dx (both
+        # replicated over ep); model-sharded leaves complete without
+        # the ep psum. Permutation collectives (SP's ppermute /
+        # all_to_all) are convention-agnostic, so SP x EP composes.
+        dym = dym / epn
     if with_aux:
         _, n_shards = _shard_norm(data_axis, seq_axis)
-        aux_ct = dauxl.astype(jnp.float32) / (M * n_shards)
+        aux_ct = dauxl.astype(jnp.float32) / (M * n_shards * epn)
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     rev_perm = [(i + 1, i) for i in range(S - 1)]
     n_buf = min(S, M)   # 1F1B in-flight bound (residency at stage s
@@ -430,9 +477,10 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, exl, key, dyl,
         b_inp = jax.lax.dynamic_index_in_dim(resid, b_slot, 0,
                                              keepdims=False)
 
-        if seq_axis is not None:
-            # SP x PP: the stage body contains collectives over
-            # ``seq_axis`` (ring ppermutes / Ulysses all-to-alls).
+        if uniform_bwd:
+            # SP x PP / EP x PP: the stage body contains collectives
+            # (seq-axis ring ppermutes / Ulysses all-to-alls, or EP's
+            # 'model' psums).
             # Those must NOT sit inside diverging lax.cond branches:
             # the F/B predicate varies over 'pipe', so stages would
             # execute DIFFERENT collective ops whose participant sets
@@ -516,11 +564,33 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, exl, key, dyl,
     # psums GPipe-AD's transpose inserts for every mesh axis the
     # params' in_spec replicates over but the cotangent varies over.
     # (dx needs no seq psum: its out_spec CARRIES the seq sharding.)
+    # Under EP the unreduced-convention shares (see the dym / epn note)
+    # complete here too: psum over ep for dx and for every leaf NOT
+    # sharded over the ep axis; ep-sharded leaves hold per-shard grads
+    # and must not mix.
+    dx_axes = ((axis_name,) if ep_axis is None
+               else (axis_name, ep_axis))
     dx = jax.lax.psum(
-        jnp.where(s == 0, dxbuf, jnp.zeros_like(dxbuf)), axis_name)
+        jnp.where(s == 0, dxbuf, jnp.zeros_like(dxbuf)), dx_axes)
     grad_axes = ((data_axis,) if seq_axis is None
                  else (data_axis, seq_axis))
-    dparams = jax.tree_util.tree_map(
-        lambda acc, p: jax.lax.psum(acc, grad_axes).astype(p.dtype),
-        dpsum, local_params)
+
+    def leaf_axes(spec):
+        if ep_axis is None or (spec is not None
+                               and ep_axis in tuple(spec)):
+            return grad_axes
+        return grad_axes + (ep_axis,)
+
+    # PartitionSpec is a tuple subclass (a pytree NODE), so flatten the
+    # spec tree with is_leaf instead of a joint tree_map.
+    flat_p, treedef = jax.tree_util.tree_flatten(local_params)
+    flat_acc = jax.tree_util.tree_leaves(dpsum)
+    if param_specs is None:
+        flat_specs = [None] * len(flat_p)
+    else:
+        flat_specs = jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda v: isinstance(v, P))
+    dparams = treedef.unflatten([
+        jax.lax.psum(acc, leaf_axes(sp_)).astype(p.dtype)
+        for acc, p, sp_ in zip(flat_acc, flat_p, flat_specs)])
     return dparams, dx.reshape(bl, t, c)

@@ -52,10 +52,26 @@ VIT_TP_RULES: Rules = (
 
 # Pipeline-parallel models (vit_pp, lm_pp): every stacked block param
 # ([depth, ...]) shards its leading layer dim over 'pipe' — contiguous
-# chunks, i.e. one stage's layers per device.
+# chunks, i.e. one stage's layers per device. MoE expert stacks
+# ([G, E, ...]) additionally shard their expert dim over 'model'
+# (EP x PP, tpunet/models/lm_pp.py; the router stacks stay replicated
+# over 'model' — routing is computed on every expert shard). Listed
+# BEFORE the catch-all so the more specific rule wins.
 VIT_PP_RULES: Rules = (
+    (r"blocks_moe_(wi|bi|wo|bo)$", P("pipe", "model")),
     (r"blocks_\w+$", P("pipe")),
 )
+
+
+def pp_stack_spec(param_name: str) -> P:
+    """The VIT_PP_RULES spec for one stacked param name — the shared
+    source of truth the pipelined models use to build their executors'
+    ``param_specs``, so the executor's shard_map in_specs can never
+    drift from how the Trainer stores the params."""
+    for rx, spec in VIT_PP_RULES:
+        if re.search(rx, param_name):
+            return spec
+    return P("pipe")
 
 
 # ZeRO-1: Adam moments shard their leading dim over 'data'; params stay
