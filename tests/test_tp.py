@@ -134,3 +134,19 @@ def test_dp_sp_tp_combined_training_parity():
     assert abs(base_t["loss"] - full_t["loss"]) < 1e-4
     assert abs(base_e["loss"] - full_e["loss"]) < 1e-4
     assert abs(base_e["accuracy"] - full_e["accuracy"]) < 1e-6
+
+
+def test_pp_stack_spec_matches_storage_rules():
+    """pp_stack_spec (what the pipelined models hand the executors as
+    shard_map in_specs) must resolve exactly what VIT_PP_RULES stores
+    params/moments under — one source of truth, no silent reshards."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpunet.parallel.tp import pp_stack_spec
+
+    assert pp_stack_spec("blocks_qkv_k") == P("pipe")
+    assert pp_stack_spec("blocks_fc1_k") == P("pipe")
+    assert pp_stack_spec("blocks_moe_rk") == P("pipe")   # router repl.
+    assert pp_stack_spec("blocks_moe_rb") == P("pipe")
+    for leaf in ("wi", "bi", "wo", "bo"):
+        assert pp_stack_spec(f"blocks_moe_{leaf}") == P("pipe", "model")

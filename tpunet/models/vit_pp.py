@@ -39,20 +39,29 @@ from tpunet.ops.flash import flash_attention, local_flash_attention
 from tpunet.parallel.pp import gpipe, onef1b
 
 
-def resolve_block_cores(attention: str):
+def resolve_block_cores(attention: str, block: int = 512):
     """(sequential_core, pipelined_core) for a pipeline model's blocks.
 
-    'dense' honors the explicit request everywhere. 'flash'/'auto' use
-    the fused kernel — but the VARIANT matters: inside the pipeline's
-    shard_map the per-shard local kernel is correct (GSPMD is already
-    done), while the sequential pipe==1 path runs under the top-level
-    jit where only the custom_partitioning-wrapped entry keeps a
-    batch-sharded mesh from all-gathering q/k/v at every layer (the
-    failure mode tpunet/ops/flash.py's partitioning section documents).
-    Both fall back to dense off-TPU.
+    'dense' honors the explicit request everywhere. 'blockwise' is the
+    pure-JAX chunked scan (O(T x block) score memory — the bounded-
+    memory core on any backend; it is mesh-free, so the same fn serves
+    both contexts). 'flash'/'auto' use the fused kernel — but the
+    VARIANT matters: inside the pipeline's shard_map the per-shard
+    local kernel is correct (GSPMD is already done), while the
+    sequential pipe==1 path runs under the top-level jit where only
+    the custom_partitioning-wrapped entry keeps a batch-sharded mesh
+    from all-gathering q/k/v at every layer (the failure mode
+    tpunet/ops/flash.py's partitioning section documents). Both fall
+    back to dense off-TPU.
     """
     if attention == "dense":
         return dense_attention, dense_attention
+    if attention == "blockwise":
+        import functools
+
+        from tpunet.ops import blockwise_attention
+        core = functools.partial(blockwise_attention, block_size=block)
+        return core, core
     return flash_attention, local_flash_attention
 
 
