@@ -226,6 +226,75 @@ def test_grad_parity_with_in_stage_seq_collective():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_grad_parity_with_in_stage_a2a_dispatch():
+    """Executor-level regression for the GShard a2a MoE lowering
+    (tpunet/models/moe.py alltoall): a stage body whose layers run the
+    full exchange pattern — dynamic_slice over the ep axis, tiled
+    all_to_all out and back, all_gather to restore replication — must
+    differentiate identically under 1f1b (manual backward, ep_axis
+    convention) and gpipe (shard_map AD). Covers the transposes the
+    manual backward's sums-to-truth-over-ep invariant must survive:
+    all_to_all (self-transposing permutation), all_gather
+    (psum-of-shares), dynamic_slice (zero-padded partials), alongside
+    ep-sharded AND ep-replicated param leaves."""
+    devs = np.array(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "pipe", "model"))
+    from jax.sharding import PartitionSpec as P
+
+    def stage(params, x, key=None):
+        W, b = params               # W [L, e_l, C, C] ep-sharded dim 1;
+        ep = jax.lax.psum(1, "model")   # b [L, C] ep-replicated
+        idx = jax.lax.axis_index("model")
+
+        def layer(carry, wb):
+            w, bb = wb              # [e_l, C, C], [C]
+            mb, t, c = carry.shape
+            e_l = w.shape[0]
+            tok = carry.reshape(mb * t, c)
+            n_l = tok.shape[0] // ep
+            tl = jax.lax.dynamic_slice_in_dim(tok, idx * n_l, n_l, 0)
+            buf = jnp.broadcast_to(tl + bb, (ep * e_l,) + tl.shape)
+            buf = jax.lax.all_to_all(buf, "model", 0, 0, tiled=True)
+            # received dim 0 = (source shard, local expert); each local
+            # expert applies its own w slice to every source's tokens
+            h = jnp.tanh(jnp.einsum(
+                "senc,ecd->send", buf.reshape(ep, e_l, n_l, c), w))
+            h = h.reshape(ep * e_l, n_l, c)
+            out = jax.lax.all_to_all(h, "model", 0, 0, tiled=True)
+            yl = out.reshape(ep, e_l, n_l, c).mean((0, 1))
+            y = jax.lax.all_gather(yl, "model", axis=0, tiled=True)
+            return carry + y.reshape(mb, t, c), None
+
+        out, _ = jax.lax.scan(layer, x, (W, b))
+        return out
+
+    rng = np.random.default_rng(0)
+    L, E, C = 4, 4, 8
+    params = (jnp.asarray(rng.normal(0, 0.3, (L, E, C, C)), jnp.float32),
+              jnp.asarray(rng.normal(0, 0.1, (L, C)), jnp.float32))
+    x = jnp.asarray(rng.normal(0, 1, (4, 4, C)), jnp.float32)
+    dy = jnp.asarray(rng.normal(0, 1, (4, 4, C)), jnp.float32)
+    p_specs = (P("pipe", "model"), P("pipe"))
+
+    def loss(executor, params, x, **kw):
+        y = executor(stage, params, x, mesh=mesh, n_micro=2,
+                     param_specs=p_specs, **kw)
+        return jnp.sum(y * dy)
+
+    with mesh:
+        ref_v, ref_g = jax.value_and_grad(
+            functools.partial(loss, gpipe), argnums=(0, 1))(params, x)
+        new_v, new_g = jax.value_and_grad(
+            functools.partial(loss, onef1b, ep_axis="model"),
+            argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(np.asarray(new_v), np.asarray(ref_v),
+                               rtol=1e-5, atol=1e-5)
+    for r, n in zip(jax.tree_util.tree_leaves(ref_g),
+                    jax.tree_util.tree_leaves(new_g)):
+        np.testing.assert_allclose(np.asarray(n), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_pipe1_fallback_matches_plain_apply():
     mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1),
                 ("data", "pipe"))

@@ -133,6 +133,12 @@ class ModelConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01      # load-balance loss weight
+    # Expert-parallel token dispatch (tpunet/models/moe.py): "auto"
+    # prefers the GShard capacity-buffer all_to_all over the expert
+    # axis when shapes divide, falling back to the replicated-routing
+    # psum lowering; "alltoall"/"replicated" force one (alltoall
+    # raises where auto would fall back).
+    moe_dispatch: str = "auto"
     # Pipeline parallelism (model name "vit_pp"): GPipe microbatches per
     # step; stages = the mesh 'pipe' axis size.
     pp_microbatches: int = 4
@@ -145,6 +151,11 @@ class ModelConfig:
     # size (max trainable sequence length).
     vocab_size: int = 256
     max_seq_len: int = 1024
+    # Vocab-sharded cross-entropy (tpunet/ops/vocab_ce.py): "auto"
+    # shards the tied output projection + CE over the mesh 'model'
+    # axis whenever it divides the vocab, so the replicated [B, T, V]
+    # float32 logits never materialize; "sharded"/"full" force one.
+    vocab_ce: str = "auto"
     # Rematerialize encoder blocks (jax.checkpoint): recompute block
     # activations in the backward pass instead of storing them — trades
     # ~1/3 more FLOPs for O(depth) less activation memory, the standard
@@ -389,6 +400,19 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--moe-every", type=int, default=None)
     p.add_argument("--moe-capacity-factor", type=float, default=None)
     p.add_argument("--moe-aux-weight", type=float, default=None)
+    p.add_argument("--moe-dispatch", default=None,
+                   choices=["auto", "alltoall", "replicated"],
+                   help="expert-parallel token dispatch: GShard "
+                        "all_to_all capacity buffers vs replicated "
+                        "routing + psum (auto prefers alltoall when "
+                        "shapes divide)")
+    p.add_argument("--vocab-ce", default=None,
+                   choices=["auto", "sharded", "full"],
+                   help="LM loss lowering: vocab-sharded logits + CE "
+                        "over the mesh 'model' axis (full [B,T,V] "
+                        "logits never materialize) vs the full-logits "
+                        "path (auto shards when the axis divides the "
+                        "vocab)")
     p.add_argument("--dropout-rate", type=float, default=None,
                    help="dropout rate for every model family (default "
                         "0.2, torchvision MobileNetV2's classifier "
@@ -493,8 +517,9 @@ def config_from_args(argv=None) -> TrainConfig:
         optim = dataclasses.replace(optim, grad_accum=args.grad_accum)
     for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads",
                  "moe_experts", "moe_top_k", "moe_every",
-                 "moe_capacity_factor", "moe_aux_weight",
-                 "pp_microbatches", "pp_schedule", "dropout_rate"):
+                 "moe_capacity_factor", "moe_aux_weight", "moe_dispatch",
+                 "vocab_ce", "pp_microbatches", "pp_schedule",
+                 "dropout_rate"):
         val = getattr(args, name)
         if val is not None:
             model = dataclasses.replace(model, **{name: val})

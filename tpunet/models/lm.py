@@ -45,6 +45,8 @@ class TransformerLM(nn.Module):
     moe_every: int = 2
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "auto"
+    moe_mesh: Any = None
     remat: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -53,12 +55,18 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False,
-                 pos_offset=0, segment_ids=None):
+                 pos_offset=0, segment_ids=None,
+                 return_hidden: bool = False):
         """``decode=True``: incremental step against the KV cache (one
         token per call after cache init); ``pos_offset`` is the absolute
         position of ``tokens[:, 0]`` in the sequence. ``segment_ids``
         [B, T] enables packed-sequence training: attention is masked to
-        same-segment tokens (composed with causality in the core)."""
+        same-segment tokens (composed with causality in the core).
+        ``return_hidden=True`` returns the final-LN hidden states
+        [B, T, C] float32 instead of logits — the vocab-sharded CE
+        hook (tpunet/ops/vocab_ce.py): the caller computes the loss
+        against the tied embedding without ever materializing the
+        [B, T, V] logits."""
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -83,12 +91,16 @@ class TransformerLM(nn.Module):
                              moe_experts=self.moe_experts if moe_here else 0,
                              moe_top_k=self.moe_top_k,
                              moe_capacity_factor=self.moe_capacity_factor,
+                             moe_dispatch=self.moe_dispatch,
+                             moe_mesh=self.moe_mesh,
                              dropout_rate=self.dropout_rate,
                              dtype=self.dtype, param_dtype=self.param_dtype,
                              name=f"block{i:02d}")(x, train, decode,
                                                    segment_ids)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln")(x)
+        if return_hidden:
+            return x.astype(jnp.float32)
         # Tied output head: logits against the embedding matrix.
         logits = embed.attend(x.astype(self.param_dtype))
         return logits.astype(jnp.float32)
@@ -108,6 +120,8 @@ def create_model(cfg: ModelConfig, mesh=None) -> TransformerLM:
         moe_every=cfg.moe_every,
         moe_top_k=cfg.moe_top_k,
         moe_capacity_factor=cfg.moe_capacity_factor,
+        moe_dispatch=cfg.moe_dispatch,
+        moe_mesh=mesh,
         remat=cfg.remat,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),

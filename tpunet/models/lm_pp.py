@@ -34,8 +34,11 @@ Packed sequences compose: ``segment_ids`` travel as the executors'
 per-microbatch ``extra`` input (each stage indexes its current
 microbatch's ids — batch metadata never hops), masking attention to
 same-segment tokens inside every block; ``--pack-docs --model lm_pp``
-works under both schedules (SP attention excluded: no segment-capable
-SP core).
+works under both schedules, including packed x SP with Ulysses
+(the seq-sharded id slice rides ``extra`` and the full-sequence local
+core masks exactly after one [mb, T/sp] -> [mb, T] id all_gather —
+tpunet/ops/attention.py ulysses_attention). Ring stays excluded: its
+state-merging core has no segment operands (the __call__ error).
 
 MoE composes as well (EP x PP): with ``--moe-experts`` the stacks are
 organized as SUPER-layers — ``moe_every - 1`` dense blocks plus one
@@ -130,15 +133,19 @@ _MOE_KEYS = ("rk", "rb", "wi", "bi", "wo", "bo")
 
 def _moe_block_apply(pa, pm, x, *, heads, top_k, capacity_factor,
                      dropout_rate=0.0, key=None, attn,
-                     segment_ids=None, ep_axis=None):
+                     segment_ids=None, ep_axis=None,
+                     ep_impl="replicated"):
     """One pre-LN block whose MLP is the routed MoE core: the shared
     attention half (vit_pp.attn_half_apply — same dropout placements
     and key split as dense blocks), then moe_apply
     (tpunet/models/moe.py) instead of the dense fc pair. Router math
     in float32 on the float32 router params (the stacked analogue of
     MoeMlp's float32 Dense). ``ep_axis`` (EP x PP): the expert params
-    hold only this device's shard over that mesh axis; moe_apply
-    routes globally and psums the assembled output. Returns (x, aux)."""
+    hold only this device's shard over that mesh axis; ``ep_impl``
+    picks the lowering — "alltoall" (GShard capacity-buffer token
+    exchange; each device routes its 1/ep token slice) or
+    "replicated" (every device routes all tokens, one psum assembles
+    the output). Returns (x, aux)."""
     mb, t, c = x.shape
     x, y, km = attn_half_apply(pa, x, heads=heads, causal=True,
                                dropout_rate=dropout_rate, key=key,
@@ -149,7 +156,7 @@ def _moe_block_apply(pa, pm, x, *, heads, top_k, capacity_factor,
     out, aux = moe_apply(tokens, logits, pm["wi"], pm["bi"], pm["wo"],
                          pm["bo"], top_k=top_k,
                          capacity_factor=capacity_factor, dtype=x.dtype,
-                         ep_axis=ep_axis)
+                         ep_axis=ep_axis, ep_impl=ep_impl)
     out = out.reshape(mb, t, c)
     if dropout_rate > 0.0 and km is not None:
         out = _dropout(out, dropout_rate, km)
@@ -171,6 +178,7 @@ class PipelinedLM(nn.Module):
     moe_every: int = 2                 # MoE in every moe_every-th block
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "auto"         # EP lowering (moe.py docstring)
     attention: str = "dense"   # dense | flash | auto | ulysses | ring
     attention_core: Any = None         # SP local core (None = auto)
     attention_block: int = 512         # blockwise/flash block inside SP
@@ -182,20 +190,31 @@ class PipelinedLM(nn.Module):
     input_kind = "tokens"              # init_variables dispatch
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False, segment_ids=None):
+    def __call__(self, tokens, train: bool = False, segment_ids=None,
+                 return_hidden: bool = False):
         """``segment_ids`` [B, T] enables packed-sequence training:
         attention masks to same-segment tokens (composed with
         causality in the core). The ids travel through the pipeline as
         the executors' non-differentiable ``extra`` input — indexed
-        per microbatch by each stage, never hopped."""
+        per microbatch by each stage, never hopped.
+        ``return_hidden=True``: final-LN hidden states [B, T, C]
+        float32 instead of logits (the vocab-sharded CE hook,
+        tpunet/ops/vocab_ce.py — at real vocabs the replicated
+        [B, T, V] float32 logits this skips dwarf the activation
+        memory the 1F1B executor saves)."""
         if self.hidden % self.heads:
             raise ValueError(f"hidden {self.hidden} not divisible by "
                              f"{self.heads} heads")
         packed = segment_ids is not None
-        if packed and self.attention in ("ulysses", "ring"):
+        if packed and self.attention == "ring":
             raise ValueError(
-                f"packed sequences need a segment-capable attention "
-                f"core (dense/flash/auto), got {self.attention!r}")
+                "packed sequences don't compose with ring attention: "
+                "the ring merges per-block (out, lse) attention STATES "
+                "and the flash state kernel has no segment operands "
+                "(tpunet/ops/flash.py local_flash_attention_state) — "
+                "use --attention ulysses (segment-capable SP: the "
+                "local core sees the full sequence and masks exactly) "
+                "or dense/flash/auto")
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -296,11 +315,16 @@ class PipelinedLM(nn.Module):
                 # or the ring's K/V rotation (global positions keep
                 # causality exact either way).
                 if self.attention == "ulysses":
-                    def attn(q, k, v, causal=True):
+                    # segment_ids (packed x SP): the seq-SHARDED id
+                    # slice rides the executors' 'extra' input;
+                    # ulysses_attention gathers it to global ids for
+                    # its full-sequence local core.
+                    def attn(q, k, v, causal=True, segment_ids=None):
                         return ulysses_attention(
                             q, k, v, axis_name="seq", causal=causal,
                             core=self.attention_core,
-                            block=self.attention_block)
+                            block=self.attention_block,
+                            segment_ids=segment_ids)
                 else:
                     def attn(q, k, v, causal=True):
                         return ring_attention(q, k, v, "seq",
@@ -309,11 +333,12 @@ class PipelinedLM(nn.Module):
             elif self.attention == "ulysses":
                 # pipe == 1: the partitioned wrapper shard_maps over
                 # 'seq' per block, same as the unpipelined LM family.
-                def attn(q, k, v, causal=True):
+                def attn(q, k, v, causal=True, segment_ids=None):
                     return ulysses_self_attention(
                         q, k, v, self.mesh, causal=causal,
                         core=self.attention_core,
-                        block=self.attention_block)
+                        block=self.attention_block,
+                        segment_ids=segment_ids)
             else:
                 def attn(q, k, v, causal=True):
                     return ring_self_attention(q, k, v, self.mesh,
@@ -326,11 +351,41 @@ class PipelinedLM(nn.Module):
 
         top_k, cap_f = self.moe_top_k, self.moe_capacity_factor
         # EP x PP: shard the expert stacks over the mesh 'model' axis
-        # inside the pipeline (routing replicated, expert FFNs on the
-        # local shard, one psum per MoE layer — moe_apply's ep_axis).
+        # inside the pipeline. The lowering (--moe-dispatch) resolves
+        # here against the static per-stage token count: "alltoall" is
+        # the GShard capacity-buffer dispatch (each device routes its
+        # 1/ep slice of the stage's tokens and two all_to_alls carry
+        # the exchange), "replicated" the routing-everywhere psum
+        # fallback (moe.py module docstring for the accounting).
         ep_axis = ("model" if (moe and pipelined
                                and self.mesh.shape.get("model", 1) > 1)
                    else None)
+        ep_impl = "replicated"
+        if ep_axis is not None:
+            from tpunet.models.moe import resolve_moe_dispatch
+            ep = self.mesh.shape["model"]
+            dp = self.mesh.shape.get("data", 1)
+            sp_n = self.mesh.shape.get("seq", 1) if sp else 1
+            if (b % (dp * self.n_micro) == 0 and t % sp_n == 0):
+                n_stage = (b // dp // self.n_micro) * (t // sp_n)
+            elif self.moe_dispatch == "alltoall":
+                raise ValueError(
+                    f"moe_dispatch='alltoall' needs batch {b} divisible "
+                    f"by data axis x microbatches ({dp} x "
+                    f"{self.n_micro}) and seq {t} by the seq axis "
+                    f"({sp_n}) to slice stage tokens over the expert "
+                    "axis")
+            else:
+                n_stage = 1   # indivisible; the executor will raise
+                #               its own divisibility error (auto path)
+            ep_impl = resolve_moe_dispatch(self.moe_dispatch, ep=ep,
+                                           n_tokens=n_stage,
+                                           n_experts=self.moe_experts)
+        elif self.moe_dispatch == "alltoall" and moe:
+            raise ValueError(
+                "moe_dispatch='alltoall' needs the pipelined EP x PP "
+                "path (mesh 'pipe' > 1 and 'model' > 1); the "
+                "unpipelined lm/vit models lower it via MoeMlp")
 
         def stage_apply(params, xs, *rest):
             # rest per the executor protocol: (extra?, key?) — extra is
@@ -399,7 +454,8 @@ class PipelinedLM(nn.Module):
                                          dropout_rate=rate, key=lk,
                                          attn=attn,
                                          segment_ids=seg_pair,
-                                         ep_axis=ep_axis)
+                                         ep_axis=ep_axis,
+                                         ep_impl=ep_impl)
                 return (xc, auxc + a), None
 
             (out, aux), _ = jax.lax.scan(
@@ -443,6 +499,8 @@ class PipelinedLM(nn.Module):
 
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln")(x)
+        if return_hidden:
+            return x.astype(jnp.float32)
         logits = embed.attend(x.astype(self.param_dtype))
         return logits.astype(jnp.float32)
 
@@ -557,6 +615,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
         moe_every=cfg.moe_every,
         moe_top_k=cfg.moe_top_k,
         moe_capacity_factor=cfg.moe_capacity_factor,
+        moe_dispatch=cfg.moe_dispatch,
         attention=cfg.attention,
         attention_core=(None if cfg.attention_core == "auto"
                         else cfg.attention_core),

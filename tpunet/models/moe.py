@@ -15,12 +15,56 @@ einsum dense-dispatch formulation (Mesh-TensorFlow / ViT-MoE / Switch):
 - Dispatch/combine are one-hot einsums; expert FFNs are a single
   batched einsum over the expert dim ([E, d, h] / [E, h, d] params).
 - Expert parallelism = sharding the expert dim of those params over
-  the mesh 'model' axis (path rules in tpunet/parallel/tp.py); GSPMD
-  turns the dispatch einsums into the all-to-alls. No separate mesh
-  axis needed.
+  the mesh 'model' axis (path rules in tpunet/parallel/tp.py).
 - Load-balance aux loss (Shazeer et al.): E * sum_e(frac_dispatched_e
   * mean_router_prob_e), sown into the 'losses' collection; the train
   step adds cfg.moe_aux_weight * sum(losses) to the CE loss.
+
+Two manual (shard_map) expert-parallel lowerings, selected by
+``ep_impl`` / ``--moe-dispatch``:
+
+- ``"alltoall"`` (preferred; ``auto`` picks it when shapes divide):
+  the GShard/Switch capacity-buffer dispatch. Each device takes its
+  1/ep SLICE of the (ep-replicated) token block, routes only that
+  slice, builds per-global-expert capacity buffers [E, c, d], and one
+  ``all_to_all`` over the expert axis ships each buffer row to the
+  device that owns that expert; local FFNs run on [E/ep, ep*c, d];
+  a second ``all_to_all`` returns expert outputs to the token owners
+  and one ``all_gather`` restores the replicated [n, d] output.
+- ``"replicated"`` (fallback, exact-global-routing semantics): every
+  device routes ALL n tokens, slices dispatch/combine to its local
+  experts, and one ``psum`` assembles the output.
+
+Comm/compute accounting, per MoE layer per device (d = model dim,
+n = tokens in the block, ep = expert-axis size, k*f = top_k *
+capacity_factor, ring collectives, bytes = dtype width):
+
+- replicated: psum of [n, d]  ->  2*(ep-1)/ep * n * d     bytes/layer
+  (grows with n); dispatch/combine einsums cost O(n * E * c) FLOPs on
+  EVERY device (replicated work).
+- alltoall:   2 a2a of [E, c_l, d] + 1 all_gather of [n/ep, d]
+              -> (ep-1)/ep * (2*k*f*n/ep + n) * d          bytes/layer
+  — the a2a pair scales with tokens/ep (k*f*n/ep each way); only the
+  boundary all_gather (restoring ep-replication for the surrounding
+  dense/attention compute, at HALF a psum's cost) still scales with n.
+  Dispatch/combine einsums drop to O(n/ep * E * c_l) — ep-fold less
+  replicated work. Crossover vs replicated at ep ≈ 2*k*f - 2 (≈ 3 at
+  the k=2, f=1.25 defaults): at ep=8 the a2a path ships 1.625x n*d vs
+  psum's 1.75x ... 2x, and its routing compute is 8x cheaper. A fully
+  token-sharded caller (tokens NOT replicated over the ep axis) would
+  drop the all_gather term entirely; at this interface the surrounding
+  per-stage compute is ep-replicated, so the boundary gather is the
+  price of composing with it.
+
+Routing-scope note: the alltoall path routes each 1/ep token slice
+independently with per-slice capacity c_l = ceil(k*(n/ep)/E * f) —
+the standard GShard scope — while the replicated path routes all n
+tokens against one global capacity. With ample capacity (no drops) the
+two produce identical outputs and identical aux (the a2a path psums
+its [E]-sized count/prob statistics over the expert axis, so the aux
+scope stays the full n-token block); under overflow the drop sets can
+differ. Same class of documented deviation as per-microbatch-shard
+routing under pipe > 1 (tpunet/models/lm_pp.py).
 """
 
 from __future__ import annotations
@@ -31,11 +75,49 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+def _route(probs, k: int, e: int, cap: int):
+    """Top-k capacity-bounded routing: ``probs`` [n, e] float32 ->
+    (dispatch [n, e, cap], combine [n, e, cap]) in float32.
+
+    Shared by both expert-parallel lowerings: position in each
+    expert's buffer is assigned by token order via a slot-major
+    cumsum (slot-0 assignments win buffer space first), overflow
+    positions are dropped, and combine carries the renormalized
+    top-k gate values."""
+    n = probs.shape[0]
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)    # [n, k]
+    gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [n,k,e]
+    flat = onehot.transpose(1, 0, 2).reshape(k * n, e)  # slot-major
+    pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0    # [k*n, e]
+    pos = pos_flat.reshape(k, n, e).transpose(1, 0, 2)  # [n, k, e]
+    fits = (pos >= 0) & (pos < cap)
+
+    pos_cap = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_cap, cap, dtype=jnp.float32)
+    kept = onehot * fits.astype(jnp.float32)            # [n, k, e]
+    dispatch = jnp.einsum("nke,nkec->nec", kept, pos_onehot)
+    combine = jnp.einsum("nke,nkec->nec",
+                         kept * gate_vals[:, :, None], pos_onehot)
+    return dispatch, combine
+
+
+def _expert_ffn(xin, wi, bi, wo, bo, dtype):
+    """Batched per-expert FFN on capacity buffers ``xin`` [e, c, d]."""
+    h = jnp.einsum("ecd,edf->ecf", xin, wi.astype(dtype))
+    h = nn.gelu(h + bi[:, None, :].astype(dtype))
+    out = jnp.einsum("ecf,efd->ecd", h, wo.astype(dtype))
+    return out + bo[:, None, :].astype(dtype)
 
 
 def moe_apply(tokens, router_logits, wi, bi, wo, bo, *,
               top_k: int, capacity_factor: float, dtype,
-              ep_axis=None) -> tuple:
+              ep_axis=None, ep_impl: str = "replicated",
+              aux_axes=None) -> tuple:
     """Functional MoE MLP core: ``tokens`` [n, d] + float32 router
     logits [n, e] -> ([n, d], aux).
 
@@ -52,22 +134,42 @@ def moe_apply(tokens, router_logits, wi, bi, wo, bo, *,
 
     ``ep_axis`` (manual expert parallelism, shard_map callers): when
     given, ``wi/bi/wo/bo`` hold only this device's expert SHARD
-    (global expert dim / axis size); routing/dispatch/aux run
-    replicated on the GLOBAL expert count (cheap: O(n x E)), each
-    device computes its local experts' FFN on its dispatch slice, and
-    one ``psum`` over ``ep_axis`` assembles the output.
+    (global expert dim / axis size) and ``tokens`` are replicated over
+    the axis. ``ep_impl`` picks the lowering (module docstring):
+    ``"alltoall"`` is the GShard capacity-buffer dispatch (token work
+    and a2a traffic scale with tokens/ep); ``"replicated"`` routes all
+    n tokens on every device and psums the output (exact global
+    routing, no token exchange — the small-scale fallback).
+    ``aux_axes`` (alltoall only) widens the aux statistics' psum scope
+    beyond (ep_axis,) — e.g. the unpipelined shard_map lowering passes
+    its data/seq axes so aux stays the global-batch scalar GSPMD
+    computes.
 
     Gradient correctness under manual sharding: with the output
-    psummed, each device's backward sees only its LOCAL experts'
-    cotangent paths (the gate path via this device's combine slice,
-    the dispatched-tokens path via its xin einsum). JAX's shard_map
-    AD tracks varying-manual-axes and completes those partial
-    cotangents with the right psums itself — measured exact against
-    the unsharded reference for every leaf (expert grads bitwise) —
-    so no manual cotangent hooks are needed (an explicit
-    identity-fwd/psum-bwd hook DOUBLE-counts: the vma machinery has
-    already inserted the psum).
+    psummed (or a2a'd + gathered), each device's backward sees only
+    its LOCAL experts' cotangent paths. JAX's shard_map AD tracks
+    varying-manual-axes and completes those partial cotangents with
+    the right collectives itself — measured exact against the
+    unsharded reference for every leaf (expert grads bitwise) — so no
+    manual cotangent hooks are needed (an explicit identity-fwd/
+    psum-bwd hook DOUBLE-counts: the vma machinery has already
+    inserted the psum). The 1F1B executor's hand-written backward
+    handles both lowerings with one convention (tpunet/parallel/pp.py
+    onef1b ep_axis): all_gather/dynamic_slice transposes
+    (psum-of-shares / zero-padded partials) and the self-transposing
+    all_to_alls all preserve its sums-to-truth-over-ep invariant.
     """
+    if ep_impl == "alltoall":
+        if ep_axis is None:
+            raise ValueError("ep_impl='alltoall' requires ep_axis")
+        return _moe_apply_a2a(tokens, router_logits, wi, bi, wo, bo,
+                              top_k=top_k,
+                              capacity_factor=capacity_factor,
+                              dtype=dtype, ep_axis=ep_axis,
+                              aux_axes=aux_axes)
+    if ep_impl != "replicated":
+        raise ValueError(f"unknown ep_impl {ep_impl!r}; "
+                         "expected replicated|alltoall")
     n, d = tokens.shape
     e_local = wi.shape[0]
     ep = jax.lax.psum(1, ep_axis) if ep_axis is not None else 1
@@ -75,27 +177,8 @@ def moe_apply(tokens, router_logits, wi, bi, wo, bo, *,
     k = min(top_k, e)
     cap = max(k, math.ceil(k * n / e * capacity_factor))
 
-    logits_f32 = router_logits.astype(jnp.float32)
-    probs = jax.nn.softmax(logits_f32, axis=-1)
-
-    gate_vals, expert_idx = jax.lax.top_k(probs, k)    # [n, k]
-    gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
-
-    # Position of each (token, slot) inside its expert's buffer,
-    # slot-major so slot-0 assignments win buffer space first.
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [n,k,e]
-    flat = onehot.transpose(1, 0, 2).reshape(k * n, e)  # slot-major
-    pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0    # [k*n, e]
-    pos = pos_flat.reshape(k, n, e).transpose(1, 0, 2)  # [n, k, e]
-    fits = (pos >= 0) & (pos < cap)
-
-    # dispatch[n, e, c] in {0,1}; combine = dispatch * gate value.
-    pos_cap = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
-    pos_onehot = jax.nn.one_hot(pos_cap, cap, dtype=jnp.float32)
-    kept = onehot * fits.astype(jnp.float32)            # [n, k, e]
-    dispatch = jnp.einsum("nke,nkec->nec", kept, pos_onehot)
-    combine = jnp.einsum("nke,nkec->nec",
-                         kept * gate_vals[:, :, None], pos_onehot)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    dispatch, combine = _route(probs, k, e, cap)
 
     # Load-balance aux loss (fraction dispatched x mean router prob).
     frac = jnp.sum(dispatch, axis=(0, 2)) / jnp.maximum(
@@ -108,36 +191,160 @@ def moe_apply(tokens, router_logits, wi, bi, wo, bo, *,
     # ``ep_axis`` each device runs only its expert shard's slice of
     # the dispatch/combine tensors and one psum assembles the output
     # (tokens are replicated over the axis, so no token all-to-all is
-    # needed — GShard's replicated-data degenerate case).
+    # needed — GShard's replicated-data degenerate case; prefer the
+    # alltoall lowering past toy scales, module docstring).
     if ep_axis is not None:
         lo = jax.lax.axis_index(ep_axis) * e_local
         dispatch = jax.lax.dynamic_slice_in_dim(dispatch, lo, e_local, 1)
         combine = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, 1)
     xin = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype),
                      tokens.astype(dtype))
-    h = jnp.einsum("ecd,edf->ecf", xin, wi.astype(dtype))
-    h = nn.gelu(h + bi[:, None, :].astype(dtype))
-    out = jnp.einsum("ecf,efd->ecd", h, wo.astype(dtype))
-    out = out + bo[:, None, :].astype(dtype)
+    out = _expert_ffn(xin, wi, bi, wo, bo, dtype)
     y = jnp.einsum("nec,ecd->nd", combine.astype(dtype), out)
     if ep_axis is not None:
         y = jax.lax.psum(y, ep_axis)
     return y, aux
 
 
+def _moe_apply_a2a(tokens, router_logits, wi, bi, wo, bo, *,
+                   top_k: int, capacity_factor: float, dtype,
+                   ep_axis, aux_axes=None) -> tuple:
+    """GShard/Switch capacity-buffer ``all_to_all`` dispatch over the
+    expert axis (module docstring). ``tokens`` [n, d] replicated over
+    ``ep_axis``; returns ([n, d] replicated, aux)."""
+    n, d = tokens.shape
+    e_local = wi.shape[0]
+    ep = jax.lax.psum(1, ep_axis)           # static: the axis size
+    e = e_local * ep
+    if n % ep:
+        raise ValueError(f"alltoall dispatch needs tokens ({n}) "
+                         f"divisible by the expert axis ({ep})")
+    n_l = n // ep
+    idx = jax.lax.axis_index(ep_axis)
+    tokens_l = jax.lax.dynamic_slice_in_dim(tokens, idx * n_l, n_l, 0)
+    logits_l = jax.lax.dynamic_slice_in_dim(router_logits,
+                                            idx * n_l, n_l, 0)
+    k = min(top_k, e)
+    cap = max(k, math.ceil(k * n_l / e * capacity_factor))
+
+    probs = jax.nn.softmax(logits_l.astype(jnp.float32), axis=-1)
+    dispatch, combine = _route(probs, k, e, cap)     # [n_l, e, cap]
+
+    # Aux statistics psum over the expert axis (plus any caller axes),
+    # so the scalar keeps the full n-token scope of the replicated
+    # path despite per-slice routing — two [e]-sized collectives.
+    # ``aux_axes`` WIDENS the scope: the expert axis is always
+    # included (omitting it would leave per-slice counts unsummed —
+    # aux diverging across ep devices).
+    axes = (ep_axis,) + tuple(ax for ax in (aux_axes or ())
+                              if ax != ep_axis)
+    group = 1
+    for ax in axes:
+        group *= jax.lax.psum(1, ax)
+    tot_counts = jax.lax.psum(jnp.sum(dispatch, axis=(0, 2)), axes)
+    tot_probs = jax.lax.psum(jnp.sum(probs, axis=0), axes)
+    frac = tot_counts / jnp.maximum(jnp.sum(tot_counts), 1.0)
+    mean_prob = tot_probs / (n_l * group)
+    aux = e * jnp.sum(frac * mean_prob)
+
+    # Dispatch: per-global-expert capacity buffers from the LOCAL
+    # token slice; the tiled all_to_all ships buffer rows
+    # [o*e_local:(o+1)*e_local] to expert-owner o. Received dim 0
+    # indexes (source shard, local expert).
+    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype),
+                     tokens_l.astype(dtype))         # [e, cap, d]
+    xin = jax.lax.all_to_all(xin, ep_axis, 0, 0, tiled=True)
+    xin = (xin.reshape(ep, e_local, cap, d)
+           .transpose(1, 0, 2, 3).reshape(e_local, ep * cap, d))
+    out = _expert_ffn(xin, wi, bi, wo, bo, dtype)
+    # Return trip: regroup by destination shard and invert the a2a;
+    # dim 0 is the global expert id again, aligned with combine's.
+    out = (out.reshape(e_local, ep, cap, d)
+           .transpose(1, 0, 2, 3).reshape(e, cap, d))
+    out = jax.lax.all_to_all(out, ep_axis, 0, 0, tiled=True)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(dtype), out)
+    # Boundary: restore ep-replication for the surrounding compute
+    # (all_gather = half a psum's bytes; a token-sharded caller could
+    # skip this — module docstring accounting).
+    return jax.lax.all_gather(y, ep_axis, axis=0, tiled=True), aux
+
+
+def resolve_moe_dispatch(dispatch: str, *, ep: int, n_tokens: int,
+                         n_experts: int) -> str:
+    """Resolve a ``--moe-dispatch`` setting against static shapes.
+
+    ``auto`` prefers ``alltoall`` whenever the shapes divide (tokens
+    by the expert-axis size, experts likewise) and falls back to
+    ``replicated`` otherwise; an explicit ``alltoall`` raises instead
+    of silently degrading. ``ep <= 1`` always means replicated (there
+    is no axis to exchange over)."""
+    if dispatch not in ("auto", "alltoall", "replicated"):
+        raise ValueError(f"unknown moe_dispatch {dispatch!r}; "
+                         "expected auto|alltoall|replicated")
+    if ep <= 1 or dispatch == "replicated":
+        if dispatch == "alltoall":
+            raise ValueError("moe_dispatch='alltoall' needs an expert "
+                             "axis > 1 (mesh 'model')")
+        return "replicated"
+    ok = n_tokens % ep == 0 and n_experts % ep == 0
+    if dispatch == "alltoall" and not ok:
+        raise ValueError(
+            f"moe_dispatch='alltoall' needs tokens ({n_tokens}) and "
+            f"experts ({n_experts}) divisible by the expert axis ({ep})")
+    return "alltoall" if ok else "replicated"
+
+
 class MoeMlp(nn.Module):
     """Sparse MLP: top-k routed experts, capacity-bounded dense dispatch.
 
     Input/output [B, T, d] — drop-in replacement for the dense MlpBlock.
-    """
+
+    ``mesh`` + ``dispatch`` (the unpipelined models' expert-parallel
+    lowering, --moe-dispatch): with a mesh whose 'model' axis > 1 and
+    ``dispatch`` resolving to "alltoall", the core runs inside a
+    shard_map over (data, seq, model) — tokens sharded over data/seq,
+    experts over 'model', the GShard a2a dispatch between them —
+    instead of leaving GSPMD to partition the global-routing einsums
+    (which psum token buffers over 'data'). Routing scope becomes
+    per-(data x seq)-shard with per-slice capacity (the documented
+    GShard deviation; aux stays the global-batch scalar via psums over
+    all three axes). Falls back to the GSPMD path when the mesh or
+    divisibility doesn't allow it (or dispatch="replicated")."""
 
     num_experts: int
     mlp_dim: int
     top_k: int = 2
     capacity_factor: float = 1.25
     dropout_rate: float = 0.0
+    dispatch: str = "auto"             # auto | alltoall | replicated
+    mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+
+    def _resolved_dispatch(self, b: int, t: int) -> str:
+        """Resolve dispatch for a [b, t, d] input against the mesh:
+        auto needs every involved axis to divide (batch by 'data', seq
+        by 'seq', the per-shard token count and the expert count by
+        'model'); explicit alltoall raises where auto falls back."""
+        mesh = self.mesh
+        if mesh is None or not {"data", "seq", "model"} <= set(mesh.shape):
+            if self.dispatch == "alltoall":
+                raise ValueError("moe_dispatch='alltoall' requires a "
+                                 "mesh with data/seq/model axes")
+            return "replicated"
+        ep = mesh.shape["model"]
+        dp = mesh.shape.get("data", 1)
+        sp = mesh.shape.get("seq", 1)
+        if b % dp or t % sp:
+            if self.dispatch == "alltoall":
+                raise ValueError(
+                    f"moe_dispatch='alltoall' needs batch {b} divisible "
+                    f"by the data axis ({dp}) and seq {t} by the seq "
+                    f"axis ({sp})")
+            return "replicated"
+        return resolve_moe_dispatch(
+            self.dispatch, ep=ep, n_tokens=(b // dp) * (t // sp),
+            n_experts=self.num_experts)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -159,10 +366,40 @@ class MoeMlp(nn.Module):
             self.param_dtype)
         bo = self.param("bo", nn.initializers.zeros, (e, d),
                         self.param_dtype)
-        y, aux = moe_apply(
-            tokens, logits, wi, bi, wo, bo,
-            top_k=self.top_k, capacity_factor=self.capacity_factor,
-            dtype=self.dtype)
+        if self._resolved_dispatch(b, t) == "alltoall":
+            y, aux = self._a2a_sharded(x, logits.reshape(b, t, e),
+                                       wi, bi, wo, bo)
+            y = y.reshape(b * t, d)
+        else:
+            y, aux = moe_apply(
+                tokens, logits, wi, bi, wo, bo,
+                top_k=self.top_k, capacity_factor=self.capacity_factor,
+                dtype=self.dtype)
         self.sow("losses", "moe_aux", aux)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return y.reshape(b, t, d)
+
+    def _a2a_sharded(self, x, logits, wi, bi, wo, bo):
+        """shard_map the a2a core over (data, seq, model): tokens and
+        router logits arrive (data x seq)-sharded and ep-replicated,
+        experts 'model'-sharded; outputs shard like the input and aux
+        replicates (its statistics psum over all three axes)."""
+        top_k, cap_f, dtype = self.top_k, self.capacity_factor, self.dtype
+
+        def body(x_l, lg_l, wi_l, bi_l, wo_l, bo_l):
+            bl, tl, dd = x_l.shape
+            y, aux = moe_apply(
+                x_l.reshape(bl * tl, dd), lg_l.reshape(bl * tl, -1),
+                wi_l, bi_l, wo_l, bo_l, top_k=top_k,
+                capacity_factor=cap_f, dtype=dtype, ep_axis="model",
+                ep_impl="alltoall", aux_axes=("data", "seq", "model"))
+            return y.reshape(bl, tl, dd), aux
+
+        tok_spec = P("data", "seq", None)
+        fn = jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(tok_spec, tok_spec, P("model", None, None),
+                      P("model", None), P("model", None, None),
+                      P("model", None)),
+            out_specs=(tok_spec, P()), check_vma=False)
+        return fn(x, logits, wi, bi, wo, bo)

@@ -71,10 +71,11 @@ class Attention(nn.Module):
         if decode:
             y = self._decode_attend(q, k, v)
         elif segment_ids is not None:
-            # Packed sequences: same-segment masking in the core. Only
-            # the dense/flash cores take the kwarg — the sequence-
-            # parallel cores raise a TypeError here by design (config
-            # validation rejects the combination up front).
+            # Packed sequences: same-segment masking in the core. The
+            # dense/flash cores and Ulysses SP take the kwarg (packed
+            # x SP composes, tpunet/ops/attention.py); ring's
+            # state-merging core doesn't — config validation rejects
+            # that combination up front and a TypeError backstops it.
             y = self.attn_fn(q, k, v,
                              segment_ids=(segment_ids, segment_ids))
         else:
@@ -151,6 +152,8 @@ class EncoderBlock(nn.Module):
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "auto"        # EP lowering (moe.py docstring)
+    moe_mesh: Any = None              # mesh for the a2a EP lowering
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -171,6 +174,8 @@ class EncoderBlock(nn.Module):
             mlp_out = MoeMlp(self.moe_experts, self.mlp_dim,
                              top_k=self.moe_top_k,
                              capacity_factor=self.moe_capacity_factor,
+                             dispatch=self.moe_dispatch,
+                             mesh=self.moe_mesh,
                              dropout_rate=self.dropout_rate,
                              dtype=self.dtype, param_dtype=self.param_dtype,
                              name="moe")(y, train)
@@ -198,6 +203,8 @@ class ViT(nn.Module):
     moe_every: int = 2                # MoE in every moe_every-th block
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "auto"
+    moe_mesh: Any = None
     remat: bool = False               # jax.checkpoint each block
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -235,6 +242,8 @@ class ViT(nn.Module):
                              moe_experts=self.moe_experts if moe_here else 0,
                              moe_top_k=self.moe_top_k,
                              moe_capacity_factor=self.moe_capacity_factor,
+                             moe_dispatch=self.moe_dispatch,
+                             moe_mesh=self.moe_mesh,
                              dropout_rate=self.dropout_rate,
                              dtype=self.dtype, param_dtype=self.param_dtype,
                              name=f"block{i:02d}")(x, train)
@@ -324,6 +333,8 @@ def create_model(cfg: ModelConfig, mesh=None) -> ViT:
         moe_every=cfg.moe_every,
         moe_top_k=cfg.moe_top_k,
         moe_capacity_factor=cfg.moe_capacity_factor,
+        moe_dispatch=cfg.moe_dispatch,
+        moe_mesh=mesh,
         remat=cfg.remat,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),
