@@ -145,11 +145,13 @@ class Trainer:
                 raise ValueError("--pack-docs needs --model lm or "
                                  "lm_pp (the segment-masked attention "
                                  "paths)")
-            if cfg.model.attention not in ("dense", "flash", "auto"):
+            if cfg.model.attention not in ("dense", "flash", "auto",
+                                           "ulysses"):
                 raise ValueError(
                     f"--pack-docs needs a segment-capable attention "
-                    f"core (dense/flash/auto), got "
-                    f"{cfg.model.attention!r}")
+                    f"core (dense/flash/auto, or ulysses for packed x "
+                    f"SP), got {cfg.model.attention!r} — ring's "
+                    "state-merging core has no segment operands")
         train_fn = (make_lm_train_step(cfg.optim, cfg.model, self.mesh,
                                        gather_params=gather_sh,
                                        packed=packed)
@@ -157,7 +159,8 @@ class Trainer:
                     else make_train_step(cfg.data, cfg.optim, cfg.model,
                                          self.mesh,
                                          gather_params=gather_sh))
-        eval_fn = (make_lm_eval_step(gather_params=gather_sh,
+        eval_fn = (make_lm_eval_step(cfg.model, self.mesh,
+                                     gather_params=gather_sh,
                                      packed=packed) if self.is_lm
                    else make_eval_step(cfg.data, gather_params=gather_sh))
         self.train_step = jax.jit(

@@ -231,9 +231,18 @@ def make_lm_train_step(optim_cfg: OptimConfig,
     bigram data, tpunet/data/lm.py). ``packed=True``: ``labels``
     carries [B, T] segment ids (tpunet/data/lm.py text_lm_packed) —
     attention is segment-masked inside the model and the loss/metrics
-    drop cross-document and padding targets."""
+    drop cross-document and padding targets.
+
+    With ``--vocab-ce`` resolving to "sharded" (auto: a mesh 'model'
+    axis > 1 dividing the vocab) the model returns final-LN hidden
+    states and the CE runs vocab-sharded against the tied embedding —
+    the replicated [B, T, V] float32 logits never materialize
+    (tpunet/ops/vocab_ce.py)."""
     aux_weight = model_cfg.moe_aux_weight
     smoothing = optim_cfg.label_smoothing
+    from tpunet.ops.vocab_ce import resolve_vocab_ce, vocab_parallel_ce
+    sharded_ce = (resolve_vocab_ce(model_cfg.vocab_ce, mesh,
+                                   model_cfg.vocab_size) == "sharded")
 
     def micro(params, batch_stats, apply_fn, tokens, labels, rng,
               grad_norm=None):
@@ -241,13 +250,25 @@ def make_lm_train_step(optim_cfg: OptimConfig,
 
         def loss_fn(params):
             kwargs = {"segment_ids": segs} if packed else {}
-            logits, mutated = apply_fn(
-                {"params": params, "batch_stats": batch_stats},
-                tokens, train=True,
-                rngs={"dropout": rng},
-                mutable=["batch_stats", "losses"], **kwargs)
-            lg, tgt = logits[:, :-1], tokens[:, 1:]
-            ce = _ce_loss(lg, tgt, smoothing)
+            tgt = tokens[:, 1:]
+            if sharded_ce:
+                h, mutated = apply_fn(
+                    {"params": params, "batch_stats": batch_stats},
+                    tokens, train=True, return_hidden=True,
+                    rngs={"dropout": rng},
+                    mutable=["batch_stats", "losses"], **kwargs)
+                ce, hit = vocab_parallel_ce(
+                    h[:, :-1], params["embed"]["embedding"], tgt,
+                    mesh, smoothing=smoothing)
+            else:
+                logits, mutated = apply_fn(
+                    {"params": params, "batch_stats": batch_stats},
+                    tokens, train=True,
+                    rngs={"dropout": rng},
+                    mutable=["batch_stats", "losses"], **kwargs)
+                lg = logits[:, :-1]
+                ce = _ce_loss(lg, tgt, smoothing)
+                hit = (jnp.argmax(lg, -1) == tgt).astype(jnp.float32)
             aux = _aux_term(mutated, aux_weight)
             if packed:
                 wt = _packed_target_weights(segs)
@@ -272,18 +293,17 @@ def make_lm_train_step(optim_cfg: OptimConfig,
             else:
                 loss = ce.mean() + aux
                 loss_sum = loss * tgt.size
-            return loss, (lg, tgt, mutated.get("batch_stats", {}),
+            return loss, (hit, mutated.get("batch_stats", {}),
                           loss_sum)
 
-        (_, (lg, tgt, new_stats, loss_sum)), grads = jax.value_and_grad(
+        (_, (hit, new_stats, loss_sum)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        hit = (jnp.argmax(lg, -1) == tgt).astype(jnp.float32)
         if packed:
             wt = _packed_target_weights(segs)
             n = jnp.sum(wt)
             correct = jnp.sum(hit * wt)
         else:
-            n = tgt.size
+            n = hit.size
             correct = jnp.sum(hit)
         return grads, new_stats, M.from_batch(loss_sum, correct, n)
 
@@ -296,29 +316,46 @@ def make_lm_train_step(optim_cfg: OptimConfig,
                              count_fn=packed_count if packed else None)
 
 
-def make_lm_eval_step(gather_params=None, packed: bool = False) -> Callable:
+def make_lm_eval_step(model_cfg: Optional[ModelConfig] = None,
+                      mesh=None, gather_params=None,
+                      packed: bool = False) -> Callable:
     """eval_step(state, tokens, labels, mask) -> metrics; ``mask`` [B]
     zeroes padded sequences so the test set is counted exactly.
     ``packed=True``: ``labels`` carries [B, T] segment ids, composing
     the per-sequence mask with the per-token packing weights.
     ``gather_params``: FSDP compute-layout tree, same as the train step
     (without it the eval forward re-runs under the pathological GSPMD
-    propagation the train step avoids)."""
+    propagation the train step avoids). ``model_cfg`` + ``mesh``:
+    --vocab-ce resolution, mirroring the train step (the eval forward
+    is where full logits would otherwise peak at the same size)."""
+    from tpunet.ops.vocab_ce import resolve_vocab_ce, vocab_parallel_ce
+    sharded_ce = (model_cfg is not None
+                  and resolve_vocab_ce(model_cfg.vocab_ce, mesh,
+                                       model_cfg.vocab_size) == "sharded")
 
     def eval_step(state: TrainState, tokens, labels, mask):
         params = state.params
         if gather_params is not None:
             params = jax.lax.with_sharding_constraint(params, gather_params)
         kwargs = {"segment_ids": labels} if packed else {}
-        logits = state.apply_fn(
-            {"params": params, "batch_stats": state.batch_stats},
-            tokens, train=False, **kwargs)
-        lg, tgt = logits[:, :-1], tokens[:, 1:]
-        losses = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
+        tgt = tokens[:, 1:]
+        if sharded_ce:
+            h = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                tokens, train=False, return_hidden=True, **kwargs)
+            losses, correct = vocab_parallel_ce(
+                h[:, :-1], params["embed"]["embedding"], tgt, mesh)
+        else:
+            logits = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                tokens, train=False, **kwargs)
+            lg = logits[:, :-1]
+            losses = optax.softmax_cross_entropy_with_integer_labels(
+                lg, tgt)
+            correct = (jnp.argmax(lg, -1) == tgt).astype(jnp.float32)
         wt = mask[:, None]
         if packed:
             wt = wt * _packed_target_weights(labels)
-        correct = (jnp.argmax(lg, -1) == tgt).astype(jnp.float32)
         return M.from_batch(jnp.sum(losses * wt), jnp.sum(correct * wt),
                             jnp.sum(wt) if packed
                             else jnp.sum(wt) * tgt.shape[1])
