@@ -328,8 +328,9 @@ def test_packed_pp_matches_unpipelined_and_isolates_segments():
 
 
 def test_packed_pp_validation():
-    """lm_pp + packed + SP attention is rejected (no segment-capable
-    SP core); the Trainer accepts --pack-docs with --model lm_pp."""
+    """lm_pp + packed + RING attention is rejected (the ring merges
+    per-block attention states and the flash state kernel has no
+    segment operands) — Ulysses is the segment-capable SP path."""
     from tpunet.parallel import make_mesh
 
     mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=2))
@@ -338,10 +339,114 @@ def test_packed_pp_validation():
     variables = init_variables(m, jax.random.PRNGKey(0), batch_size=8,
                                seq_len=16)
     toks = jnp.zeros((8, 16), jnp.int32)
-    with pytest.raises(ValueError, match="segment-capable"):
+    with pytest.raises(ValueError, match="ring"):
         with mesh:
             m.apply(variables, toks, train=True,
                     segment_ids=jnp.ones((8, 16), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Packed x SP: the segment-capable Ulysses core
+# ---------------------------------------------------------------------------
+
+def _packed_case(b=8, t=16, vocab=64, seed=7):
+    """Packed rows: doc 1 (cols :6), doc 2 (cols 6:13), padding tail."""
+    rng = np.random.default_rng(seed)
+    toks = jnp.asarray(rng.integers(0, vocab, (b, t)), jnp.int32)
+    segs = jnp.asarray(np.concatenate(
+        [np.full((b, 6), 1), np.full((b, 7), 2), np.full((b, 3), 0)],
+        axis=1), jnp.int32)
+    return toks, segs
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,mesh_cfg,sched", [
+    ("lm", MeshConfig(data=2, seq=2), "gpipe"),
+    ("lm_pp", MeshConfig(data=2, seq=2), "gpipe"),      # pipe=1 SP path
+    ("lm_pp", MeshConfig(data=2, seq=2, pipe=2), "gpipe"),
+    ("lm_pp", MeshConfig(data=2, seq=2, pipe=2), "1f1b"),
+])
+def test_packed_sp_matches_unsharded_packed(name, mesh_cfg, sched):
+    """Packed x SP (Ulysses): forward and grads on dp x sp (and
+    dp x sp x pp, both schedules) equal the unsharded packed lm_pp —
+    the seq-sharded segment ids ride the executors' `extra` input and
+    ulysses_attention's one-id-all_gather rebuilds exact global
+    masking inside its full-sequence local core."""
+    from tpunet.parallel import make_mesh
+
+    toks, segs = _packed_case()
+    base = create_model(dataclasses.replace(PP_CFG,
+                                            attention_core="blockwise"))
+    variables = init_variables(base, jax.random.PRNGKey(0),
+                               batch_size=8, seq_len=16)
+    params = {"params": variables["params"]}
+    ref = base.apply(params, toks, train=True, segment_ids=segs)
+
+    def grads(model, mesh):
+        def loss(p):
+            lg = model.apply({"params": p}, toks, train=True,
+                             segment_ids=segs)
+            wt = (segs[:, 1:] == segs[:, :-1]) & (segs[:, 1:] > 0)
+            return jnp.sum(jnp.where(wt, jnp.mean(lg[:, :-1] ** 2, -1),
+                                     0.0)) / jnp.sum(wt)
+        if mesh is None:
+            return jax.grad(loss)(variables["params"])
+        with mesh:
+            return jax.grad(loss)(variables["params"])
+
+    g_ref = grads(base, None)
+    mesh = make_mesh(mesh_cfg)
+    cfg = dataclasses.replace(PP_CFG, name=name, attention="ulysses",
+                              attention_core="blockwise",
+                              pp_schedule=sched)
+    m = create_model(cfg, mesh=mesh)
+    with mesh:
+        if name == "lm":
+            # same architecture, unstacked params
+            from tpunet.models.lm_pp import to_transformer_lm_params
+            lp = to_transformer_lm_params(variables["params"])
+            o = m.apply({"params": lp}, toks, train=True,
+                        segment_ids=segs)
+        else:
+            o = m.apply(params, toks, train=True, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    if name == "lm_pp":
+        g = grads(m, mesh)
+        for (pth, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(g),
+                jax.tree_util.tree_leaves_with_path(g_ref)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6,
+                err_msg=f"{mesh_cfg}: {jax.tree_util.keystr(pth)}")
+
+
+def test_packed_sp_isolates_documents():
+    """Document isolation UNDER sequence sharding, in the direction
+    only segment masking protects: the packed boundary (col 6) does
+    not align with the seq-shard boundary (col 8 on sp=2), so doc 2
+    spans both shards — mutating doc 1 must not move doc 2's logits
+    through the gathered-id masking, on dp x sp and dp x sp x pp."""
+    from tpunet.parallel import make_mesh
+
+    toks, segs = _packed_case()
+    base = create_model(PP_CFG)
+    variables = init_variables(base, jax.random.PRNGKey(0),
+                               batch_size=8, seq_len=16)
+    params = {"params": variables["params"]}
+    toks2 = toks.at[:, :6].set((toks[:, :6] + 5) % 64)
+    for mesh_cfg in (MeshConfig(data=2, seq=2),
+                     MeshConfig(data=2, seq=2, pipe=2)):
+        mesh = make_mesh(mesh_cfg)
+        m = create_model(dataclasses.replace(
+            PP_CFG, attention="ulysses", attention_core="blockwise"),
+            mesh=mesh)
+        with mesh:
+            a = m.apply(params, toks, train=False, segment_ids=segs)
+            b = m.apply(params, toks2, train=False, segment_ids=segs)
+        np.testing.assert_allclose(np.asarray(a[:, 6:13]),
+                                   np.asarray(b[:, 6:13]), atol=1e-6)
+        assert not np.allclose(np.asarray(a[:, :6]), np.asarray(b[:, :6]))
 
 
 @pytest.mark.slow

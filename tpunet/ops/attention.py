@@ -55,20 +55,24 @@ def _block_update(carry: Tuple[jax.Array, jax.Array, jax.Array],
                                                       jax.Array]:
     """Fold one K/V block into the (m, l, acc) running softmax state.
 
-    q [B,Tq,H,D]; k,v [B,Tk,H,D]; mask [Tq,Tk] bool (True = attend) or
-    None. m,l [B,H,Tq]; acc [B,Tq,H,D]. All state float32.
+    q [B,Tq,H,D]; k,v [B,Tk,H,D]; mask [Tq,Tk] or — per-example
+    (packed-segment) masks — [B,Tq,Tk] bool (True = attend) or None.
+    m,l [B,H,Tq]; acc [B,Tq,H,D]. All state float32.
     """
     m, l, acc = carry
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None, :, :], s, _NEG_INF)
+        if mask.ndim == 2:
+            mask = mask[None]
+        mask = mask[:, None]                       # broadcast over heads
+        s = jnp.where(mask, s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     # Rows with nothing to attend to yet keep m at the initial floor;
     # exp(s - floor) would overflow, so shift defensively.
     p = jnp.exp(s - m_new[..., None])
     if mask is not None:
-        p = jnp.where(mask[None, None, :, :], p, 0.0)
+        p = jnp.where(mask, p, 0.0)
     corr = jnp.exp(m - m_new)
     l = l * corr + jnp.sum(p, axis=-1)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p, v,
@@ -136,33 +140,46 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         block_size: int = 512,
                         causal: bool = False,
-                        scale: Optional[float] = None) -> jax.Array:
+                        scale: Optional[float] = None,
+                        segment_ids=None) -> jax.Array:
     """Online-softmax attention over K/V chunks of ``block_size``.
 
     Memory is O(Tq * block_size) instead of O(Tq * Tk); exact same
-    result as ``dense_attention``.
-    """
+    result as ``dense_attention``. ``segment_ids``: optional
+    (q_seg [B,Tq], kv_seg [B,Tk]) pair for packed sequences — the
+    kv-block slice of the mask rides the scan, keeping the
+    O(Tq * block_size) bound."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     tq, tk = q.shape[1], k.shape[1]
     block_size = min(block_size, tk)
     if tk % block_size != 0:
         raise ValueError(f"seq len {tk} not divisible by block {block_size}")
     n_blocks = tk // block_size
-    kb = k.reshape(k.shape[0], n_blocks, block_size, *k.shape[2:])
-    vb = v.reshape(v.shape[0], n_blocks, block_size, *v.shape[2:])
+    b = k.shape[0]
+    kb = k.reshape(b, n_blocks, block_size, *k.shape[2:])
+    vb = v.reshape(b, n_blocks, block_size, *v.shape[2:])
     q_pos = jnp.arange(tq)
+    if segment_ids is not None:
+        q_seg, kv_seg = segment_ids
+        sb = kv_seg.reshape(b, n_blocks, block_size).swapaxes(0, 1)
+    else:
+        q_seg = None
+        sb = jnp.zeros((n_blocks, 0), jnp.int32)   # scan arity filler
 
     def body(carry, xs):
-        j, k_j, v_j = xs
+        j, k_j, v_j, s_j = xs
         mask = None
         if causal:
             k_pos = j * block_size + jnp.arange(block_size)
             mask = q_pos[:, None] + (tk - tq) >= k_pos[None, :]
+        if q_seg is not None:
+            seg = q_seg[:, :, None] == s_j[:, None, :]  # [B, Tq, bs]
+            mask = seg if mask is None else mask[None] & seg
         return _block_update(carry, q, k_j, v_j, scale, mask), None
 
     (m, l, acc), _ = jax.lax.scan(
         body, _init_carry(q),
-        (jnp.arange(n_blocks), kb.swapaxes(0, 1), vb.swapaxes(0, 1)))
+        (jnp.arange(n_blocks), kb.swapaxes(0, 1), vb.swapaxes(0, 1), sb))
     return _finalize(m, l, acc, q.dtype)
 
 
@@ -329,14 +346,17 @@ def _auto_block(t: int, cap: int = 512) -> int:
 
 
 def _local_full_attention(q, k, v, causal, scale, core: Optional[str],
-                          block: Optional[int] = None):
+                          block: Optional[int] = None,
+                          segment_ids=None):
     """The locally-dense full-sequence core used inside Ulysses.
 
     ``core`` None resolves to the Pallas flash kernel on TPU (measured
     1.31x the blockwise scan, tpunet/ops/flash.py) and the blockwise
     scan elsewhere; "flash"/"blockwise" force a choice ("flash" off-TPU
     runs the kernel in interpret mode — test use only). ``block``
-    overrides the kernel/scan block size (cfg.attention_block)."""
+    overrides the kernel/scan block size (cfg.attention_block).
+    ``segment_ids``: optional (q_seg, kv_seg) pair — both cores are
+    segment-capable (packed x SP)."""
     if core is None:
         core = "flash" if jax.default_backend() == "tpu" else "blockwise"
     if core == "flash":
@@ -345,7 +365,8 @@ def _local_full_attention(q, k, v, causal, scale, core: Optional[str],
         b = block or 512
         return local_flash_attention(q, k, v, causal=causal, scale=scale,
                                      block_q=b, block_k=b,
-                                     interpret=interpret)
+                                     interpret=interpret,
+                                     segment_ids=segment_ids)
     if core == "blockwise":
         # ``block`` is a CAP clamped to a divisor of the local length.
         # An EXPLICIT cap is honored even below _auto_block's 64 floor
@@ -354,7 +375,8 @@ def _local_full_attention(q, k, v, causal, scale, core: Optional[str],
         bs = (_divisor_block(q.shape[1], block) if block
               else _auto_block(q.shape[1]))
         return blockwise_attention(q, k, v, block_size=bs,
-                                   causal=causal, scale=scale)
+                                   causal=causal, scale=scale,
+                                   segment_ids=segment_ids)
     raise ValueError(f"unknown attention core {core!r}")
 
 
@@ -363,7 +385,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       causal: bool = False,
                       scale: Optional[float] = None,
                       core: Optional[str] = None,
-                      block: Optional[int] = None) -> jax.Array:
+                      block: Optional[int] = None,
+                      segment_ids=None) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style),
     shard_map body: inputs arrive seq-sharded [B, T/s, H, D]; one
     all-to-all (q/k/v stacked, so it is a single collective) re-shards
@@ -373,7 +396,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     sharding. Two collectives total per call — fewer than the ring's
     per-step hops when heads divide the axis — at the cost of holding
     full-T activations per head group (the scores themselves stay in
-    VMEM / O(T x block))."""
+    VMEM / O(T x block)).
+
+    ``segment_ids`` (packed x SP): a (q_seg, kv_seg) pair of
+    seq-SHARDED [B, T/s] int arrays (equal for self-attention). The
+    local core sees the full sequence per head group, so segment
+    masking is exact under sharding: one [B, T/s] -> [B, T]
+    ``all_gather`` (int32 metadata, negligible next to the qkv
+    all-to-all) rebuilds the global ids the core masks with."""
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(
             f"ulysses_attention is self-attention only (q {q.shape}, "
@@ -383,13 +413,20 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if q.shape[2] % n:
         raise ValueError(
             f"{q.shape[2]} heads not divisible by sequence axis {n}")
+    seg = None
+    if segment_ids is not None:
+        ids = segment_ids[0]     # self-attention: q_seg is kv_seg
+        if n > 1:
+            ids = jax.lax.all_gather(ids, axis_name, axis=1, tiled=True)
+        seg = (ids, ids)
     if n == 1:
-        return _local_full_attention(q, k, v, causal, scale, core, block)
+        return _local_full_attention(q, k, v, causal, scale, core, block,
+                                     segment_ids=seg)
     # [3, B, T/s, H, D] -> [3, B, T, H/s, D]: split heads, concat seq.
     qkv = jax.lax.all_to_all(jnp.stack([q, k, v]), axis_name,
                              split_axis=3, concat_axis=2, tiled=True)
     out = _local_full_attention(qkv[0], qkv[1], qkv[2], causal, scale,
-                                core, block)
+                                core, block, segment_ids=seg)
     # [B, T, H/s, D] -> [B, T/s, H, D]: split seq, concat heads.
     return jax.lax.all_to_all(out, axis_name, split_axis=1,
                               concat_axis=2, tiled=True)
@@ -403,20 +440,37 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            causal: bool = False,
                            scale: Optional[float] = None,
                            core: Optional[str] = None,
-                           block: Optional[int] = None) -> jax.Array:
+                           block: Optional[int] = None,
+                           segment_ids=None) -> jax.Array:
     """shard_map wrapper for ``ulysses_attention`` (mirror of
     ``ring_self_attention``, including pass-through tensor-parallel
-    head sharding — local heads must still divide the seq axis)."""
+    head sharding — local heads must still divide the seq axis).
+    ``segment_ids``: optional (q_seg, kv_seg) [B, T] pair (packed
+    sequences) — sharded over ``seq_axis`` into the body, where the
+    gather-and-mask happens."""
     h_ax = _resolve_head_axis(mesh, head_axis, q.shape[2],
                               local_divisor=mesh.shape[seq_axis])
     spec = P(batch_axis, seq_axis, h_ax, None)
+    if segment_ids is None:
+        fn = jax.shard_map(
+            functools.partial(ulysses_attention, axis_name=seq_axis,
+                              causal=causal, scale=scale, core=core,
+                              block=block),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+    s_spec = P(batch_axis, seq_axis)
+
+    def body(q, k, v, q_seg, kv_seg):
+        return ulysses_attention(q, k, v, axis_name=seq_axis,
+                                 causal=causal, scale=scale, core=core,
+                                 block=block,
+                                 segment_ids=(q_seg, kv_seg))
+
     fn = jax.shard_map(
-        functools.partial(ulysses_attention, axis_name=seq_axis,
-                          causal=causal, scale=scale, core=core,
-                          block=block),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+        body, mesh=mesh, in_specs=(spec, spec, spec, s_spec, s_spec),
+        out_specs=spec, check_vma=False)
+    return fn(q, k, v, *segment_ids)
 
 
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
