@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Measure the checkpoint save-stall: per-epoch wall-clock of the same
+synthetic training run with per-epoch full-state saves ON vs OFF.
+
+With async checkpointing (tpunet/ckpt/orbax_io.py) the save dispatch
+overlaps the next epoch's compute, so the ON-vs-OFF delta bounds the
+stall the step loop actually pays (device->host snapshot + any drain of
+the previous write). Writes runs/ckpt-async/STALL.json.
+
+Usage: python scripts/bench_ckpt_stall.py [--epochs N] [--out DIR]
+(CPU-friendly; run under the virtual device mesh for the sharded path.)
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run(save_last: bool, epochs: int):
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.data.cifar10 import synthetic_cifar10
+    from tpunet.train.loop import Trainer
+
+    with tempfile.TemporaryDirectory() as d:
+        cfg = TrainConfig(
+            epochs=epochs,
+            data=DataConfig(dataset="synthetic", image_size=32,
+                            batch_size=32),
+            model=ModelConfig(width_mult=0.5, dtype="float32"),
+            optim=OptimConfig(learning_rate=1e-3),
+            mesh=MeshConfig(),
+            checkpoint=CheckpointConfig(directory=d, save_best=False,
+                                        save_last=save_last),
+        )
+        tr = Trainer(cfg, dataset=synthetic_cifar10(n_train=512,
+                                                    n_test=32))
+        times, dispatch = [], []
+        try:
+            tr.train_one_epoch(0)            # compile warmup
+            for e in range(1, epochs + 1):
+                t0 = time.perf_counter()
+                tr.train_one_epoch(e)
+                if save_last:
+                    t1 = time.perf_counter()
+                    tr.ckpt.save_state(e, tr._payload())
+                    dispatch.append(time.perf_counter() - t1)
+                times.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            tr.ckpt.wait()
+            drain = time.perf_counter() - t0
+        finally:
+            tr.close()
+        return times, dispatch, drain
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "runs", "ckpt-async"))
+    args = ap.parse_args()
+
+    t_off, _, _ = run(False, args.epochs)
+    t_on, dispatch, drain = run(True, args.epochs)
+    mean = lambda xs: sum(xs) / len(xs)
+    # dispatch_seconds is what the step loop actually pays per save
+    # (the on-device snapshot + worker handoff — the TPU-relevant
+    # stall); the epoch delta additionally includes this CPU harness's
+    # core CONTENTION with the background writer (training and the
+    # orbax serializer share the same 8 host cores here, a cost a TPU
+    # chip does not pay). Epoch 1 carries the one-time manager
+    # initialization; the pre-async baseline measured ~13s first
+    # dispatch / ~1.0s steady BLOCKING per save at this exact shape.
+    rec = {
+        "epochs": args.epochs,
+        "epoch_seconds_no_save": [round(t, 4) for t in t_off],
+        "epoch_seconds_with_save": [round(t, 4) for t in t_on],
+        "dispatch_seconds": [round(t, 4) for t in dispatch],
+        "mean_dispatch": round(mean(dispatch[1:]), 4),
+        "pre_async_dispatch_first_and_steady": [12.975, 1.0],
+        "first_save_epoch_seconds": round(t_on[0], 4),
+        "mean_no_save": round(mean(t_off[1:]), 4),
+        "mean_with_save": round(mean(t_on[1:]), 4),
+        "epoch_delta_incl_cpu_contention": round(
+            mean(t_on[1:]) - mean(t_off[1:]), 4),
+        "final_drain_seconds": round(drain, 4),
+        "note": "fully-async saves (tpunet/ckpt/orbax_io.py): the "
+                "step loop pays only dispatch_seconds (on-device "
+                "snapshot + worker handoff, ~0.3s steady vs ~1.0s "
+                "blocking + 13s first-save before); orbax's blocking "
+                "phase + serialization + IO run on a background "
+                "worker behind the next epoch, with >1-outstanding "
+                "back-pressure bounding snapshot memory. The write "
+                "residue surfaces as final_drain_seconds at wait().",
+    }
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "STALL.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
