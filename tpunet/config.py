@@ -143,10 +143,17 @@ class ModelConfig:
     # step; stages = the mesh 'pipe' axis size.
     pp_microbatches: int = 4
     # Pipeline schedule: "gpipe" (AD-emitted backward: all forwards,
-    # then all backwards) or "1f1b" (manual-VJP backward interleaving
+    # then all backwards), "1f1b" (manual-VJP backward interleaving
     # fwd/bwd per microbatch — O(min(S, M)) live stage inputs instead
-    # of O(M) stacked per-layer internals; same grads, parity-tested).
+    # of O(M) stacked per-layer internals; same grads, parity-tested),
+    # or "interleaved" (virtual pipeline stages: pp_virtual chunks per
+    # device cut the bubble fraction ~pp_virtual-fold at a bounded
+    # 1F1B-style memory cost — tpunet/parallel/pp.py interleaved).
     pp_schedule: str = "gpipe"
+    # Chunks per device for pp_schedule="interleaved" (Megatron's v);
+    # depth must divide into pipe * pp_virtual chunks and
+    # pp_microbatches into whole pipe-axis groups.
+    pp_virtual: int = 2
     # LM family (model name "lm"): vocab and the learned-position table
     # size (max trainable sequence length).
     vocab_size: int = 256
@@ -359,10 +366,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatches per step (vit_pp)")
     p.add_argument("--pp-schedule", default=None,
-                   choices=["gpipe", "1f1b"],
-                   help="pipeline schedule: gpipe (AD backward) or "
-                        "1f1b (manual-VJP interleaved backward, "
-                        "bounded activation memory)")
+                   choices=["gpipe", "1f1b", "interleaved"],
+                   help="pipeline schedule: gpipe (AD backward), 1f1b "
+                        "(manual-VJP backward, bounded activation "
+                        "memory), or interleaved (virtual stages: "
+                        "--pp-virtual chunks per device, ~v-fold "
+                        "smaller bubble at 1F1B-style memory)")
+    p.add_argument("--pp-virtual", type=int, default=None,
+                   help="chunks per device for --pp-schedule "
+                        "interleaved (depth must divide pipe x v)")
     p.add_argument("--attention", default=None,
                    choices=["auto", "dense", "blockwise", "flash",
                             "ring", "ulysses"],
@@ -519,7 +531,7 @@ def config_from_args(argv=None) -> TrainConfig:
                  "moe_experts", "moe_top_k", "moe_every",
                  "moe_capacity_factor", "moe_aux_weight", "moe_dispatch",
                  "vocab_ce", "pp_microbatches", "pp_schedule",
-                 "dropout_rate"):
+                 "pp_virtual", "dropout_rate"):
         val = getattr(args, name)
         if val is not None:
             model = dataclasses.replace(model, **{name: val})

@@ -30,7 +30,7 @@ from tpunet.models.lm import generate
 def load_lm(model_cfg: ModelConfig,
             checkpoint_dir: Optional[str] = None,
             variables: Optional[dict] = None,
-            mesh=None) -> Tuple[object, dict]:
+            mesh=None, train_pipe: int = 0) -> Tuple[object, dict]:
     """Build the LM and load its best-checkpoint params (sequence-
     parallel attention configs swap to dense, same function — mirrors
     infer.Predictor). Pipeline-trained checkpoints (name 'lm_pp')
@@ -105,9 +105,25 @@ def load_lm(model_cfg: ModelConfig,
     if is_pp and "blocks_qkv_k" in variables["params"]:
         # Stacked pipeline layout (restored above, or passed in directly
         # by an in-process caller): unstack into the TransformerLM tree.
+        # ``train_pipe`` > 0 marks an INTERLEAVED-schedule checkpoint,
+        # whose stacks are chunk-permuted: pass the training run's
+        # pipe-axis size (the checkpoint's cfg.pp_virtual gives v).
+        # When the checkpoint carries the best_meta.json sidecar
+        # (tpunet/ckpt/orbax_io.py save_best), the layout comes from
+        # THERE — no operator-remembered flags needed; an explicit
+        # --train-pipe still overrides.
         from tpunet.models.lm_pp import to_transformer_lm_params
+        virtual = restore_cfg.pp_virtual
+        if not train_pipe and checkpoint_dir:
+            meta = Checkpointer(
+                CheckpointConfig(directory=checkpoint_dir)).best_meta()
+            if meta and meta.get("pp_layout_pipe", 0):
+                train_pipe = int(meta["pp_layout_pipe"])
+                virtual = int(meta["pp_layout_virtual"])
+        kw = ({"pipe": train_pipe, "virtual": virtual}
+              if train_pipe else {})
         variables = {"params":
-                     to_transformer_lm_params(variables["params"])}
+                     to_transformer_lm_params(variables["params"], **kw)}
     params = variables["params"]
     if tp and not sharded:
         params = jax.device_put(
@@ -168,6 +184,14 @@ def main(argv=None):
                         "(and the KV cache's head dim) over N devices "
                         "via the Megatron path rules — for checkpoints "
                         "too big for one chip's HBM (0 = single-chip)")
+    p.add_argument("--train-pipe", type=int, default=0,
+                   help="for --model lm_pp checkpoints trained with "
+                        "--pp-schedule interleaved: the training "
+                        "run's --mesh-pipe (the stacks are chunk-"
+                        "permuted; 0 = gpipe/1f1b checkpoint)")
+    p.add_argument("--pp-virtual", type=int, default=2,
+                   help="--pp-virtual of the interleaved training run "
+                        "(ignored unless --train-pipe > 0)")
     p.add_argument("--prompt-format", choices=("auto", "bytes", "ids"),
                    default="auto",
                    help="how to read --prompt: 'bytes' = UTF-8 text "
@@ -196,7 +220,8 @@ def main(argv=None):
                       moe_experts=args.moe_experts,
                       moe_every=args.moe_every,
                       moe_top_k=args.moe_top_k,
-                      moe_capacity_factor=args.moe_capacity_factor)
+                      moe_capacity_factor=args.moe_capacity_factor,
+                      pp_virtual=args.pp_virtual)
     if byte_prompt:
         # Byte-level checkpoint (--dataset text_lm): the prompt IS text.
         prompt_len = len(args.prompt.encode("utf-8"))
@@ -227,7 +252,7 @@ def main(argv=None):
         from tpunet.parallel import make_mesh
         mesh = make_mesh(MeshConfig(data=1, model=args.mesh_model))
     model, variables = load_lm(cfg, checkpoint_dir=args.checkpoint_dir,
-                               mesh=mesh)
+                               mesh=mesh, train_pipe=args.train_pipe)
     if byte_prompt:
         text = generate_text(model, variables, args.prompt, args.tokens,
                              temperature=args.temperature,

@@ -111,7 +111,8 @@ from tpunet.models.vit_pp import (_dropout, _stacked_lecun_normal,
 from tpunet.ops.attention import (ring_attention, ring_self_attention,
                                   ulysses_attention,
                                   ulysses_self_attention)
-from tpunet.parallel.pp import gpipe, onef1b
+from tpunet.parallel.pp import (gpipe, interleaved,
+                                interleaved_layer_order, onef1b)
 
 
 def _stacked_expert_normal(key, shape, dtype=jnp.float32):
@@ -182,7 +183,8 @@ class PipelinedLM(nn.Module):
     attention: str = "dense"   # dense | flash | auto | ulysses | ring
     attention_core: Any = None         # SP local core (None = auto)
     attention_block: int = 512         # blockwise/flash block inside SP
-    schedule: str = "gpipe"            # gpipe | 1f1b (pp.py executors)
+    schedule: str = "gpipe"    # gpipe | 1f1b | interleaved (pp.py)
+    virtual: int = 2                   # chunks/device for interleaved
     mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -463,7 +465,24 @@ class PipelinedLM(nn.Module):
                 (pa, pf, pm, jnp.arange(gl)))
             return out, aux
 
-        if pipelined:
+        if pipelined and self.schedule == "interleaved":
+            # Virtual stages: the executor reinterprets each device's
+            # contiguous P('pipe') slice as `virtual` chunks (global
+            # stage j*S + d — chunk-PERMUTED storage,
+            # interleaved_layer_order; to_transformer_lm_params takes
+            # (pipe, virtual) to unstack such checkpoints). Dense
+            # blocks only: MoE/packed/SP compose with gpipe/1f1b —
+            # interleaved's contribution is the ~v-fold smaller
+            # bubble (create_model rejects the combinations).
+            if moe or packed or sp:
+                raise ValueError(
+                    "pp_schedule='interleaved' supports dense/flash "
+                    "blocks only — compose MoE/packed/SP with "
+                    "gpipe/1f1b")
+            x = interleaved(stage_apply, blocks, x, mesh=self.mesh,
+                            n_micro=self.n_micro,
+                            n_virtual=self.virtual, key=key)
+        elif pipelined:
             executor = onef1b if self.schedule == "1f1b" else gpipe
             pspecs = None
             kw = {}
@@ -505,17 +524,35 @@ class PipelinedLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def to_transformer_lm_params(params: dict) -> dict:
+def to_transformer_lm_params(params: dict, *, pipe: int = None,
+                             virtual: int = None) -> dict:
     """Unstack a PipelinedLM param tree into TransformerLM's layout
     (block{i:02d}/attn/..., tpunet/models/lm.py) — the two are the same
     architecture, so lm_pp training checkpoints serve through the
     TransformerLM KV-cache generation path. MoE stacks (present when
     the model was trained with --moe-experts) unstack into the
     block{i}/moe/{router, wi, bi, wo, bo} layout of MoeMlp; the MoE
-    period is recovered from the stack shapes (L / G)."""
+    period is recovered from the stack shapes (L / G).
+
+    ``pipe`` + ``virtual`` (interleaved checkpoints): stacks trained
+    with pp_schedule='interleaved' are stored chunk-PERMUTED
+    (interleaved_layer_order — device d's contiguous 'pipe' slice
+    holds chunks d, S+d, ...), so unstacking them needs the training
+    run's pipe-axis size and --pp-virtual to recover semantic layer
+    order. Leave both None for gpipe/1f1b checkpoints."""
+    if (pipe is None) != (virtual is None):
+        raise ValueError("pass pipe and virtual together (both from "
+                         "the interleaved training run) or neither")
     out = {"embed": params["embed"], "pos_embed": params["pos_embed"],
            "ln": params["ln"]}
     L = params["blocks_qkv_k"].shape[0]
+    if pipe is not None:
+        order = interleaved_layer_order(L, pipe, virtual)
+        inv = sorted(range(L), key=order.__getitem__)
+        params = {k: (v[jnp.asarray(inv)]
+                      if k.startswith("blocks_") and v.shape[0] == L
+                      else v)
+                  for k, v in params.items()}
     moe = "blocks_moe_wi" in params
     m_every = L // params["blocks_moe_wi"].shape[0] if moe else 0
     for i in range(L):
@@ -592,9 +629,34 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
         raise ValueError("lm_pp does not support --remat (the pipeline "
                          "scan already bounds activation memory per "
                          "stage)")
-    if cfg.pp_schedule not in ("gpipe", "1f1b"):
+    if cfg.pp_schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}; "
-                         "expected gpipe|1f1b")
+                         "expected gpipe|1f1b|interleaved")
+    if cfg.pp_schedule == "interleaved":
+        stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        if stages < 2:
+            raise ValueError(
+                "pp_schedule='interleaved' needs a mesh 'pipe' axis "
+                "> 1 (at pipe=1 use gpipe/1f1b — the sequential "
+                "fallback would have to un-permute chunk storage)")
+        if cfg.pp_virtual < 2:
+            raise ValueError(f"--pp-virtual must be >= 2 (got "
+                             f"{cfg.pp_virtual}); v=1 IS gpipe/1f1b")
+        if cfg.vit_depth % (stages * cfg.pp_virtual):
+            raise ValueError(
+                f"--vit-depth {cfg.vit_depth} not divisible by "
+                f"{stages} stages x {cfg.pp_virtual} virtual chunks")
+        if cfg.pp_microbatches % stages:
+            raise ValueError(
+                f"--pp-microbatches {cfg.pp_microbatches} not "
+                f"divisible by the pipe axis ({stages}) — the "
+                "interleaved F-stream cycles chunks per "
+                "stage-count-sized microbatch group")
+        if cfg.moe_experts > 0 or cfg.attention in ("ulysses", "ring"):
+            raise ValueError(
+                "pp_schedule='interleaved' composes with dense/flash "
+                "blocks only (no MoE, no SP) — use gpipe/1f1b for "
+                "those compositions")
     if mesh is not None:
         stages = mesh.shape.get("pipe", 1)
         if stages > 1 and cfg.vit_depth % stages:
@@ -621,6 +683,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
                         else cfg.attention_core),
         attention_block=cfg.attention_block,
         schedule=cfg.pp_schedule,
+        virtual=cfg.pp_virtual,
         mesh=mesh,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),

@@ -36,7 +36,7 @@ from flax import linen as nn
 from tpunet.config import ModelConfig
 from tpunet.ops import dense_attention
 from tpunet.ops.flash import flash_attention, local_flash_attention
-from tpunet.parallel.pp import gpipe, onef1b
+from tpunet.parallel.pp import gpipe, interleaved, onef1b
 
 
 def resolve_block_cores(attention: str, block: int = 512):
@@ -155,7 +155,8 @@ class PipelinedViT(nn.Module):
     n_micro: int = 4
     dropout_rate: float = 0.0
     attention: str = "dense"           # dense | flash | auto
-    schedule: str = "gpipe"            # gpipe | 1f1b (pp.py executors)
+    schedule: str = "gpipe"    # gpipe | 1f1b | interleaved (pp.py)
+    virtual: int = 2                   # chunks/device for interleaved
     mesh: Any = None                   # jax.sharding.Mesh or None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -233,7 +234,13 @@ class PipelinedViT(nn.Module):
             out, _ = jax.lax.scan(body, xs, (params, idx))
             return out
 
-        if pipelined:
+        if pipelined and self.schedule == "interleaved":
+            # Virtual stages (chunk-permuted 'pipe' storage — see
+            # tpunet/parallel/pp.py interleaved / lm_pp's note).
+            x = interleaved(stage_apply, blocks, x, mesh=self.mesh,
+                            n_micro=self.n_micro,
+                            n_virtual=self.virtual, key=key)
+        elif pipelined:
             executor = onef1b if self.schedule == "1f1b" else gpipe
             x = executor(stage_apply, blocks, x, mesh=self.mesh,
                          n_micro=self.n_micro, key=key)
@@ -263,9 +270,26 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
         raise ValueError("vit_pp does not support MoE blocks (the "
                          "MoE x PP composition lives in the LM "
                          "family: --model lm_pp --moe-experts N)")
-    if cfg.pp_schedule not in ("gpipe", "1f1b"):
+    if cfg.pp_schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}; "
-                         "expected gpipe|1f1b")
+                         "expected gpipe|1f1b|interleaved")
+    if cfg.pp_schedule == "interleaved":
+        stages = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        if stages < 2:
+            raise ValueError(
+                "pp_schedule='interleaved' needs a mesh 'pipe' axis "
+                "> 1 (use gpipe/1f1b at pipe=1)")
+        if cfg.pp_virtual < 2:
+            raise ValueError(f"--pp-virtual must be >= 2 (got "
+                             f"{cfg.pp_virtual})")
+        if cfg.vit_depth % (stages * cfg.pp_virtual):
+            raise ValueError(
+                f"vit_depth {cfg.vit_depth} not divisible by "
+                f"{stages} stages x {cfg.pp_virtual} virtual chunks")
+        if cfg.pp_microbatches % stages:
+            raise ValueError(
+                f"--pp-microbatches {cfg.pp_microbatches} not "
+                f"divisible by the pipe axis ({stages})")
     if cfg.remat:
         # Same contract as lm_pp: a silently-ignored memory flag is a
         # trap — the pipeline already bounds activation memory per
@@ -290,6 +314,7 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedViT:
         dropout_rate=cfg.dropout_rate,
         attention=cfg.attention,
         schedule=cfg.pp_schedule,
+        virtual=cfg.pp_virtual,
         mesh=mesh,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),

@@ -594,3 +594,553 @@ def _onef1b_bwd_body(stage_apply, local_params, xl, exl, key, dyl,
         jax.lax.psum(acc, leaf_axes(sp_)).astype(p.dtype)
         for acc, p, sp_ in zip(flat_acc, flat_p, flat_specs)])
     return dparams, dx.reshape(bl, t, c)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved 1F1B: virtual pipeline stages (Megatron-style), manual VJP.
+# ---------------------------------------------------------------------------
+#
+# Each device holds v model CHUNKS instead of one contiguous stage:
+# global stage g = j * S + d lives on device d as its local chunk j
+# (stacked params stay P('pipe')-sharded; the contiguous local slice is
+# REINTERPRETED as [v, layers/chunk] — the chunk-permuted storage order,
+# interleaved_layer_order). Activations hop a FULL ring (wraparound
+# (S-1) -> 0 carries chunk j's output into chunk j+1).
+#
+# Why: the non-interleaved schedules' bubble fraction is
+# (S-1)/(M+S-1) regardless of schedule (gpipe == 1f1b there). Counting
+# in CHUNK-ticks (1 chunk = 1/v of a device's layers — the honest unit
+# when comparing against v chunks/device), a non-interleaved step costs
+# 2v(M + S - 1) chunk-ticks; the interleaved forward is a dense
+# closed-form circular pipeline finishing in vM + S - 1, and the
+# combined replay/backward table measures ~2vM + O(vS) — bubble
+# fraction ~(S-1)/(vM), the ~v-fold reduction (Narayanan et al. 2021).
+# Measured tables (tests/test_pp_interleaved.py): the schedule-table
+# bubble and the XLA memory analysis quantify bubble x memory against
+# gpipe/1f1b.
+#
+# The backward is a hand-written custom_vjp like onef1b: one combined
+# scan replays chunk forwards and runs chunk backwards in Megatron's
+# warmup / one-F-one-B / cooldown order. Unlike onef1b's closed-form
+# tick table, hop slack here is NOT uniformly 1 (steady-state F and B
+# streams cross devices with phase offsets), so the schedule is built
+# HOST-SIDE by a greedy list scheduler (interleaved_bwd_schedule) that
+# also performs interval allocation for three bounded ring buffers —
+# saved chunk inputs (residuals) and in-flight F/B arrivals — and the
+# device-side scan just indexes the resulting [T, S] tables. Residency
+# stays O(S*v + slack) chunk inputs per device (measured in the memory
+# test), not gpipe-AD's O(M) stacked per-tick internals.
+#
+# Scope (fail-loud): no with_aux/MoE, no seq sharding, no extra/packed
+# metadata — compose those with gpipe/1f1b; interleaved's contribution
+# is the bubble. Requires n_micro % S == 0 (Megatron's constraint: the
+# F-stream cycles chunks per S-microbatch group) and layers % (S*v) == 0.
+
+
+def interleaved_layer_order(L: int, S: int, v: int) -> list:
+    """``order[storage_idx] = semantic layer`` for the chunk-permuted
+    stacking: device d's contiguous P('pipe') slice holds chunks
+    d, S+d, 2S+d, ... (global stage g = j*S + d), so storage position
+    d*(v*lc) + j*lc + o carries semantic layer (j*S + d)*lc + o."""
+    if L % (S * v):
+        raise ValueError(f"{L} layers not divisible by {S} stages x "
+                         f"{v} virtual chunks")
+    lc = L // (S * v)
+    order = []
+    for d in range(S):
+        for j in range(v):
+            g = j * S + d
+            order.extend(range(g * lc, (g + 1) * lc))
+    return order
+
+
+def interleaved_fwd_schedule(S: int, M: int, v: int) -> list:
+    """The closed-form dense forward table: ``table[t][d]`` is
+    ``("F", m, j)`` or None. Device d runs its k-th chunk-op at tick
+    d + k with k enumerating (microbatch-group, chunk, in-group
+    microbatch): k = (m // S)*S*v + j*S + (m % S). Every hop
+    (d -> d+1, and the (S-1) -> 0 wrap into the next chunk) lands with
+    slack exactly 1, so the forward needs no arrival buffering and
+    finishes in vM + S - 1 ticks."""
+    if M % S:
+        raise ValueError(f"interleaved needs microbatches ({M}) "
+                         f"divisible by stages ({S})")
+    n = v * M
+    table = [[None] * S for _ in range(n + S - 1)]
+    for d in range(S):
+        for k in range(n):
+            r, kk = divmod(k, S * v)
+            j, i = divmod(kk, S)
+            table[d + k][d] = ("F", r * S + i, j)
+    return table
+
+
+def _interleaved_oplist(S: int, M: int, v: int, d: int) -> list:
+    """Device d's backward-scan op order (Megatron interleaved 1F1B):
+    W(d) warmup chunk-forwards, then one-F-one-B, then B cooldown.
+    F-stream order matches the forward schedule; the B stream is the
+    same enumeration with chunks reversed (deepest chunk first)."""
+    def fop(k):
+        r, kk = divmod(k, S * v)
+        j, i = divmod(kk, S)
+        return ("F", r * S + i, j)
+
+    def bop(b):
+        r, bb = divmod(b, S * v)
+        j, i = divmod(bb, S)
+        return ("B", r * S + i, v - 1 - j)
+
+    n = v * M
+    W = min(n, 2 * (S - 1 - d) + (v - 1) * S)
+    ops = [fop(k) for k in range(W)]
+    f, b = W, 0
+    while f < n:
+        ops.append(fop(f)); f += 1
+        ops.append(bop(b)); b += 1
+    while b < n:
+        ops.append(bop(b)); b += 1
+    return ops
+
+
+def _alloc_intervals(intervals):
+    """Greedy interval-graph slot allocation: ``intervals`` is a list of
+    (start, end, key) with inclusive occupancy [start, end]; returns
+    ({key: slot}, n_slots)."""
+    slots = {}
+    free = []
+    busy = []   # (end, slot) active
+    n = 0
+    for start, end, key in sorted(intervals):
+        # release slots whose interval ended before this start
+        still = []
+        for e, sl in busy:
+            if e < start:
+                free.append(sl)
+            else:
+                still.append((e, sl))
+        busy = still
+        if free:
+            sl = free.pop()
+        else:
+            sl = n
+            n += 1
+        busy.append((end, sl))
+        slots[key] = sl
+    return slots, max(n, 1)
+
+
+def interleaved_bwd_schedule(S: int, M: int, v: int) -> dict:
+    """Host-side greedy list scheduling of the combined replay/backward
+    scan, plus buffer allocation. Returns numpy tables [T, S]:
+
+    - kind (0 idle / 1 F / 2 B), m, j;
+    - rs_save / rs_read: residual-ring slot an F-tick saves its chunk
+      input into / a B-tick reads from (-1 none);
+    - af_save / ab_save: arrival-ring slot to store THIS tick's
+      ppermute delivery into (-1 discard) — hop slack can exceed 1, so
+      deliveries wait in per-device rings until their consumer tick;
+    - af_read / ab_read: arrival slot an F/B-tick reads its input
+      cotangent/activation from (-1 = boundary: xm / dy);
+
+    and scalars n_resid / n_arr_f / n_arr_b / n_ticks. Dependencies
+    (producer tick + 1 <= consumer tick, F-before-its-B) are enforced
+    during construction; the property tests re-verify independently."""
+    import numpy as np
+    if M % S:
+        raise ValueError(f"interleaved needs microbatches ({M}) "
+                         f"divisible by stages ({S})")
+    n = v * M
+    ops = [_interleaved_oplist(S, M, v, d) for d in range(S)]
+    for d in range(S):   # F(m, j) precedes B(m, j) on every device
+        pos = {op: i for i, op in enumerate(ops[d])}
+        for (kind, m, j), i in pos.items():
+            if kind == "B":
+                assert pos[("F", m, j)] < i, (d, m, j)
+    ptr = [0] * S
+    done_f, done_b = {}, {}
+    rows = []
+    t = 0
+    while any(p < len(o) for p, o in zip(ptr, ops)):
+        row = [None] * S
+        for d in range(S):
+            if ptr[d] >= len(ops[d]):
+                continue
+            kind, m, j = ops[d][ptr[d]]
+            if kind == "F":
+                if d > 0:
+                    ready = done_f.get((d - 1, m, j))
+                elif j > 0:
+                    ready = done_f.get((S - 1, m, j - 1))
+                else:
+                    ready = -1                      # xm always there
+            else:
+                own = done_f.get((d, m, j))
+                if d < S - 1:
+                    up = done_b.get((d + 1, m, j))
+                elif j < v - 1:
+                    up = done_b.get((0, m, j + 1))
+                else:
+                    up = -1                         # dy always there
+                ready = (None if own is None or up is None
+                         else max(own, up))
+            if ready is not None and t >= ready + 1:
+                row[d] = (kind, m, j)
+        if all(r is None for r in row):
+            raise RuntimeError(
+                f"interleaved schedule deadlock at tick {t} "
+                f"(S={S}, M={M}, v={v})")
+        for d in range(S):
+            if row[d] is not None:
+                kind, m, j = row[d]
+                (done_f if kind == "F" else done_b)[(d, m, j)] = t
+                ptr[d] += 1
+        rows.append(row)
+        t += 1
+    T = len(rows)
+
+    kind = np.zeros((T, S), np.int32)
+    mi = np.zeros((T, S), np.int32)
+    ji = np.zeros((T, S), np.int32)
+    rs_save = -np.ones((T, S), np.int32)
+    rs_read = -np.ones((T, S), np.int32)
+    af_save = -np.ones((T, S), np.int32)
+    af_read = -np.ones((T, S), np.int32)
+    ab_save = -np.ones((T, S), np.int32)
+    ab_read = -np.ones((T, S), np.int32)
+    for t, row in enumerate(rows):
+        for d, op in enumerate(row):
+            if op is None:
+                continue
+            kind[t, d] = 1 if op[0] == "F" else 2
+            mi[t, d] = op[1]
+            ji[t, d] = op[2]
+
+    n_res = n_af = n_ab = 1
+    for d in range(S):
+        # residuals: input saved at F(m, j), read at B(m, j)
+        iv = [(done_f[(d, m, j)], done_b[(d, m, j)], (m, j))
+              for m in range(M) for j in range(v)]
+        sl, nr = _alloc_intervals(iv)
+        n_res = max(n_res, nr)
+        for (m, j), s_ in sl.items():
+            rs_save[done_f[(d, m, j)], d] = s_
+            rs_read[done_b[(d, m, j)], d] = s_
+        # F arrivals: produced upstream at tp, stored here at tp+1,
+        # read at this device's F tick
+        iv = []
+        for m in range(M):
+            for j in range(v):
+                if d > 0:
+                    tp = done_f[(d - 1, m, j)]
+                elif j > 0:
+                    tp = done_f[(S - 1, m, j - 1)]
+                else:
+                    continue                        # from xm
+                iv.append((tp + 1, done_f[(d, m, j)], (m, j)))
+        if iv:
+            sl, na = _alloc_intervals(iv)
+            n_af = max(n_af, na)
+            for (m, j), s_ in sl.items():
+                iv_start = [x for x in iv if x[2] == (m, j)][0][0]
+                af_save[iv_start, d] = s_
+                af_read[done_f[(d, m, j)], d] = s_
+        # B arrivals: cotangent produced downstream at tp
+        iv = []
+        for m in range(M):
+            for j in range(v):
+                if d < S - 1:
+                    tp = done_b[(d + 1, m, j)]
+                elif j < v - 1:
+                    tp = done_b[(0, m, j + 1)]
+                else:
+                    continue                        # from dy
+                iv.append((tp + 1, done_b[(d, m, j)], (m, j)))
+        if iv:
+            sl, nb = _alloc_intervals(iv)
+            n_ab = max(n_ab, nb)
+            for (m, j), s_ in sl.items():
+                iv_start = [x for x in iv if x[2] == (m, j)][0][0]
+                ab_save[iv_start, d] = s_
+                ab_read[done_b[(d, m, j)], d] = s_
+    return dict(kind=kind, m=mi, j=ji, rs_save=rs_save, rs_read=rs_read,
+                af_save=af_save, af_read=af_read, ab_save=ab_save,
+                ab_read=ab_read, n_resid=n_res, n_arr_f=n_af,
+                n_arr_b=n_ab, n_ticks=T)
+
+
+def interleaved(stage_apply: Callable, stacked_params, x, *,
+                mesh: Mesh, n_micro: int, n_virtual: int = 2,
+                axis_name: str = "pipe", data_axis: str = "data",
+                key=None):
+    """Interleaved-1F1B pipeline executor (module section comment).
+
+    Contract differs from gpipe/onef1b in ONE way: ``stage_apply``
+    receives a CHUNK's params — leading dim layers/(S*v) — instead of
+    a stage's, with ``key`` (when given) already folded per
+    (microbatch, global stage); the chunk body folds per local layer.
+    ``stacked_params`` leaves are the usual [L, ...] stacks sharded
+    P('pipe'), REINTERPRETED chunk-permuted (interleaved_layer_order):
+    callers that assign semantic meaning to stack positions (unstack
+    converters, sequential fallbacks) must apply the permutation.
+    No with_aux / seq_axis / extra support (fail-loud; compose those
+    features with gpipe/1f1b)."""
+    S = mesh.shape[axis_name]
+    v = n_virtual
+    if v < 2:
+        raise ValueError(f"interleaved needs n_virtual >= 2 chunks "
+                         f"per device (got {v}); use gpipe/1f1b at "
+                         "v=1")
+    if S == 1:
+        raise ValueError("interleaved needs a 'pipe' mesh axis > 1 "
+                         "(the sequential fallback would have to "
+                         "un-permute the chunk storage; use "
+                         "gpipe/1f1b at pipe=1)")
+    if n_micro % S:
+        raise ValueError(f"interleaved needs n_micro ({n_micro}) "
+                         f"divisible by the pipe axis ({S}) — the "
+                         "F-stream cycles chunks per S-microbatch "
+                         "group")
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
+        if leaf.shape[0] % (S * v):
+            raise ValueError(
+                f"stacked param {jax.tree_util.keystr(path)} leading "
+                f"dim {leaf.shape[0]} not divisible by {S} stages x "
+                f"{v} chunks")
+
+    sched = interleaved_bwd_schedule(S, n_micro, v)
+    p_specs = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                     stacked_params)
+    x_spec = P(data_axis, None, None)
+    keyed = key is not None
+    kk = key if keyed else jnp.zeros((2,), jnp.uint32)
+    kw = dict(n_micro=n_micro, n_virtual=v, n_stages=S,
+              axis_name=axis_name, data_axis=data_axis, keyed=keyed)
+
+    def fwd_program(params, xx, k):
+        body = functools.partial(_ileave_fwd_body, stage_apply, **kw)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(p_specs, x_spec, P()),
+            out_specs=x_spec, check_vma=False)(params, xx, k)
+
+    def bwd_program(params, xx, k, dy):
+        body = functools.partial(_ileave_bwd_body, stage_apply,
+                                 sched=sched, **kw)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(p_specs, x_spec, P(), x_spec),
+            out_specs=(p_specs, x_spec), check_vma=False)(
+                params, xx, k, dy)
+
+    @jax.custom_vjp
+    def run(params, xx, k):
+        return fwd_program(params, xx, k)
+
+    def run_fwd(params, xx, k):
+        return fwd_program(params, xx, k), (params, xx, k)
+
+    def run_bwd(res, dy):
+        params, xx, k = res
+        dparams, dx = bwd_program(params, xx, k, dy)
+        dk = np.zeros(np.shape(k), dtype=jax.dtypes.float0)
+        return dparams, dx, dk
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked_params, x, kk)
+
+
+def _ileave_chunks(local_params, v):
+    """Reinterpret the local [L/S, ...] stack as [v, lc, ...] chunks."""
+    return jax.tree_util.tree_map(
+        lambda p: p.reshape((v, p.shape[0] // v) + p.shape[1:]),
+        local_params)
+
+
+def _ileave_chunk_params(chunks, j):
+    """Chunk j's param slice out of the [v, lc, ...] local stacks."""
+    return jax.tree_util.tree_map(
+        lambda p: jax.lax.dynamic_index_in_dim(p, j, 0, keepdims=False),
+        chunks)
+
+
+def _ileave_run(stage_apply, cp, x, m, g, key, keyed):
+    """Apply one chunk with the key folded per (microbatch, global
+    stage). The ONE fold location: forward body, backward replay and
+    the backward's vjp'd function all route through here, so replayed
+    dropout masks match the primal bit-for-bit by construction."""
+    if keyed:
+        k = jax.random.fold_in(jax.random.fold_in(key, m), g)
+        return stage_apply(cp, x, k)
+    return stage_apply(cp, x)
+
+
+def _ileave_apply(stage_apply, chunks, j, x, m, s, S, key, keyed):
+    """Index chunk j and run it (see _ileave_run)."""
+    cp = _ileave_chunk_params(chunks, j)
+    return cp, _ileave_run(stage_apply, cp, x, m, j * S + s, key, keyed)
+
+
+def _ileave_fwd_body(stage_apply, local_params, xl, key, *, n_micro,
+                     n_virtual, n_stages, axis_name, data_axis,
+                     keyed):
+    """Dense circular forward: vM + S - 1 ticks, closed-form indices
+    (interleaved_fwd_schedule), full-ring ppermute each tick."""
+    s = jax.lax.axis_index(axis_name)
+    S, M, v = n_stages, n_micro, n_virtual
+    bl, t, c = xl.shape
+    if bl % M:
+        raise ValueError(f"local batch {bl} not divisible by "
+                         f"{M} microbatches")
+    mb = bl // M
+    xm = xl.reshape(M, mb, t, c)
+    chunks = _ileave_chunks(local_params, v)
+    ring = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t_):
+        act_in, outbuf = carry
+        k = t_ - s
+        valid = (k >= 0) & (k < v * M)
+        kc = jnp.clip(k, 0, v * M - 1)
+        kk = kc % (S * v)
+        m = (kc // (S * v)) * S + (kk % S)
+        j = kk // S
+        inp = jnp.where((s == 0) & (j == 0),
+                        jax.lax.dynamic_index_in_dim(xm, m, 0,
+                                                     keepdims=False),
+                        act_in)
+        _, y = _ileave_apply(stage_apply, chunks, j, inp, m, s, S,
+                             key, keyed)
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        is_out = valid & (s == S - 1) & (j == v - 1)
+        outbuf = jax.lax.dynamic_update_index_in_dim(
+            outbuf,
+            jnp.where(is_out, y,
+                      jax.lax.dynamic_index_in_dim(outbuf, m, 0,
+                                                   keepdims=False)),
+            m, 0)
+        return (jax.lax.ppermute(y, axis_name, ring), outbuf), None
+
+    act0 = jnp.zeros((mb, t, c), xl.dtype)
+    (_, outbuf), _ = jax.lax.scan(
+        tick, (act0, jnp.zeros_like(xm)),
+        jnp.arange(v * M + S - 1))
+    outbuf = jax.lax.psum(
+        jnp.where(s == S - 1, outbuf, jnp.zeros_like(outbuf)),
+        axis_name)
+    return outbuf.reshape(bl, t, c)
+
+
+def _ileave_bwd_body(stage_apply, local_params, xl, key, dyl, *, sched,
+                     n_micro, n_virtual, n_stages, axis_name,
+                     data_axis, keyed):
+    """Combined replay/backward scan over the host-built table: per
+    tick, store ring-delivered arrivals into their allocated slots,
+    run this device's op (F replay saving its input to the residual
+    ring, or B vjp-ing the saved input against the arrived cotangent),
+    and ppermute both streams around the full ring."""
+    s = jax.lax.axis_index(axis_name)
+    S, M, v = n_stages, n_micro, n_virtual
+    bl, t, c = xl.shape
+    mb = bl // M
+    xm = xl.reshape(M, mb, t, c)
+    dym = dyl.reshape(M, mb, t, c)
+    chunks = _ileave_chunks(local_params, v)
+    fwd_ring = [(i, (i + 1) % S) for i in range(S)]
+    bwd_ring = [((i + 1) % S, i) for i in range(S)]
+    tbl = jax.tree_util.tree_map(
+        jnp.asarray, {k_: sched[k_] for k_ in
+                      ("kind", "m", "j", "rs_save", "rs_read",
+                       "af_save", "af_read", "ab_save", "ab_read")})
+
+    def store(buf, slot, val):
+        cur = jax.lax.dynamic_index_in_dim(
+            buf, jnp.maximum(slot, 0), 0, keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(
+            buf, jnp.where(slot >= 0, val, cur), jnp.maximum(slot, 0), 0)
+
+    def load(buf, slot):
+        return jax.lax.dynamic_index_in_dim(
+            buf, jnp.maximum(slot, 0), 0, keepdims=False)
+
+    def tick(carry, row):
+        act_in, cot_in, arr_f, arr_b, resid, dpsum, dxbuf = carry
+        col = {k_: row[k_][s] for k_ in row}
+        kind, m, j = col["kind"], col["m"], col["j"]
+        is_f, is_b = kind == 1, kind == 2
+        # 1. bank this tick's ring deliveries
+        arr_f = store(arr_f, col["af_save"], act_in)
+        arr_b = store(arr_b, col["ab_save"], cot_in)
+        # 2. inputs
+        x_f = jnp.where((s == 0) & (j == 0) & (col["af_read"] < 0),
+                        jax.lax.dynamic_index_in_dim(xm, m, 0,
+                                                     keepdims=False),
+                        load(arr_f, col["af_read"]))
+        x_b = load(resid, col["rs_read"])
+        g_in = jnp.where((s == S - 1) & (j == v - 1)
+                         & (col["ab_read"] < 0),
+                         jax.lax.dynamic_index_in_dim(dym, m, 0,
+                                                      keepdims=False),
+                         load(arr_b, col["ab_read"]))
+        # 3. the op: collective-free chunk bodies, so the cheap
+        # cond schedule runs only the branch each tick needs (idle
+        # ticks land in do_b on zeros, masked below — onef1b's trick)
+        zero_dp = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape[1:], p.dtype), chunks)
+
+        def do_f(_):
+            _, y = _ileave_apply(stage_apply, chunks, j, x_f, m, s, S,
+                                 key, keyed)
+            return y, jnp.zeros_like(x_f), zero_dp
+
+        def do_b(_):
+            cp = _ileave_chunk_params(chunks, j)
+            _, pull = jax.vjp(
+                lambda c, xi: _ileave_run(stage_apply, c, xi, m,
+                                          j * S + s, key, keyed),
+                cp, x_b)
+            dp, dx = pull(g_in)
+            return jnp.zeros_like(x_b), dx, dp
+
+        y, dx, dp = jax.lax.cond(is_f, do_f, do_b, None)
+        y = jnp.where(is_f, y, jnp.zeros_like(y))
+        dx = jnp.where(is_b, dx, jnp.zeros_like(dx))
+        # 4. bookkeeping
+        resid = store(resid, jnp.where(is_f, col["rs_save"], -1), x_f)
+        dpsum = jax.tree_util.tree_map(
+            lambda acc, g_: jax.lax.dynamic_update_index_in_dim(
+                acc,
+                jax.lax.dynamic_index_in_dim(acc, j, 0, keepdims=False)
+                + jnp.where(is_b, g_, jnp.zeros_like(g_)
+                            ).astype(acc.dtype),
+                j, 0),
+            dpsum, dp)
+        oldx = jax.lax.dynamic_index_in_dim(dxbuf, m, 0, keepdims=False)
+        dxbuf = jax.lax.dynamic_update_index_in_dim(
+            dxbuf, jnp.where(is_b & (s == 0) & (j == 0), dx, oldx),
+            m, 0)
+        return (jax.lax.ppermute(y, axis_name, fwd_ring),
+                jax.lax.ppermute(dx, axis_name, bwd_ring),
+                arr_f, arr_b, resid, dpsum, dxbuf), None
+
+    shp = (mb, t, c)
+    carry0 = (
+        jnp.zeros(shp, xl.dtype),
+        jnp.zeros(shp, dyl.dtype),
+        jnp.zeros((sched["n_arr_f"],) + shp, xl.dtype),
+        jnp.zeros((sched["n_arr_b"],) + shp, dyl.dtype),
+        jnp.zeros((sched["n_resid"],) + shp, xl.dtype),
+        jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), chunks),
+        jnp.zeros_like(dym),
+    )
+    (_, _, _, _, _, dpsum, dxbuf), _ = jax.lax.scan(
+        tick, carry0, tbl)
+    dx = jax.lax.psum(
+        jnp.where(s == 0, dxbuf, jnp.zeros_like(dxbuf)), axis_name)
+    # chunk grads back to the [L/S, ...] stack; each data shard saw
+    # only its microbatches -> complete over 'data' (as in onef1b).
+    dparams = jax.tree_util.tree_map(
+        lambda acc, p: jax.lax.psum(
+            acc.reshape((acc.shape[0] * acc.shape[1],)
+                        + acc.shape[2:]),
+            data_axis).astype(p.dtype),
+        dpsum, local_params)
+    return dparams, dx.reshape(bl, t, c)

@@ -198,6 +198,19 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
+    def _pp_layout(self) -> np.ndarray:
+        """[pipe, virtual] when the stacked params are stored in the
+        interleaved schedule's chunk-PERMUTED order, else [0, 0] —
+        persisted with the state so a resume under a different
+        (schedule, pipe, virtual) fails loudly instead of silently
+        reinterpreting a layer-scrambled stack
+        (tpunet/parallel/pp.py interleaved_layer_order)."""
+        il = (self.cfg.model.pp_schedule == "interleaved"
+              and self.mesh.shape.get("pipe", 1) > 1)
+        return np.asarray(
+            [self.mesh.shape.get("pipe", 1), self.cfg.model.pp_virtual]
+            if il else [0, 0], np.int32)
+
     def _payload(self, completed: bool = True) -> Dict:
         return {
             "state": self.state,
@@ -209,12 +222,26 @@ class Trainer:
             "completed": np.asarray(int(completed), np.int32),
             "global_step": np.asarray(self.global_step, np.int32),
             "best_acc": np.asarray(self.best_acc, np.float32),
+            "pp_layout": self._pp_layout(),
         }
 
     def _try_resume(self) -> None:
         restored = self.ckpt.restore_state(self._payload())
         if restored is None:
             return
+        got = [int(x) for x in np.asarray(restored.get(
+            "pp_layout", np.zeros(2, np.int32)))]
+        want = [int(x) for x in self._pp_layout()]
+        if got != want:
+            def name(lay):
+                return ("gpipe/1f1b layout" if lay[0] == 0 else
+                        f"interleaved pipe={lay[0]} virtual={lay[1]}")
+            raise ValueError(
+                f"checkpoint stack layout mismatch: saved with "
+                f"{name(got)}, resuming with {name(want)} — the "
+                "interleaved schedule stores chunk-permuted layer "
+                "stacks, so resume with the same --pp-schedule/"
+                "--mesh-pipe/--pp-virtual as the original run")
         self.state = restored["state"]
         completed = int(restored.get("completed", 1))
         self.start_epoch = int(restored["epoch"]) + (1 if completed else 0)
@@ -434,12 +461,18 @@ class Trainer:
                     # EMA weights + EMA BN stats — save that pair (what
                     # inference loads).
                     ema_on = cfg.optim.ema_decay > 0
+                    lay = self._pp_layout()
                     self.ckpt.save_best({
                         "params": (self.state.ema_params if ema_on
                                    else self.state.params),
                         "batch_stats": (self.state.ema_batch_stats
                                         if ema_on
                                         else self.state.batch_stats),
+                    }, meta={
+                        "model": cfg.model.name,
+                        "pp_schedule": cfg.model.pp_schedule,
+                        "pp_layout_pipe": int(lay[0]),
+                        "pp_layout_virtual": int(lay[1]),
                     })
                 self.start_epoch = epoch
                 self.ckpt.save_state(epoch, self._payload())
