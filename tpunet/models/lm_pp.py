@@ -470,18 +470,20 @@ class PipelinedLM(nn.Module):
             # contiguous P('pipe') slice as `virtual` chunks (global
             # stage j*S + d — chunk-PERMUTED storage,
             # interleaved_layer_order; to_transformer_lm_params takes
-            # (pipe, virtual) to unstack such checkpoints). Dense
-            # blocks only: MoE/packed/SP compose with gpipe/1f1b —
+            # (pipe, virtual) to unstack such checkpoints). Packed
+            # segment ids ride the executor's `extra` input like the
+            # other schedules; MoE/SP compose with gpipe/1f1b —
             # interleaved's contribution is the ~v-fold smaller
-            # bubble (create_model rejects the combinations).
-            if moe or packed or sp:
+            # bubble (create_model rejects those combinations).
+            if moe or sp:
                 raise ValueError(
                     "pp_schedule='interleaved' supports dense/flash "
-                    "blocks only — compose MoE/packed/SP with "
+                    "blocks (packed included) — compose MoE/SP with "
                     "gpipe/1f1b")
             x = interleaved(stage_apply, blocks, x, mesh=self.mesh,
                             n_micro=self.n_micro,
-                            n_virtual=self.virtual, key=key)
+                            n_virtual=self.virtual, key=key,
+                            extra=segment_ids)
         elif pipelined:
             executor = onef1b if self.schedule == "1f1b" else gpipe
             pspecs = None

@@ -871,7 +871,7 @@ def interleaved_bwd_schedule(S: int, M: int, v: int) -> dict:
 def interleaved(stage_apply: Callable, stacked_params, x, *,
                 mesh: Mesh, n_micro: int, n_virtual: int = 2,
                 axis_name: str = "pipe", data_axis: str = "data",
-                key=None):
+                key=None, extra=None):
     """Interleaved-1F1B pipeline executor (module section comment).
 
     Contract differs from gpipe/onef1b in ONE way: ``stage_apply``
@@ -882,8 +882,12 @@ def interleaved(stage_apply: Callable, stacked_params, x, *,
     P('pipe'), REINTERPRETED chunk-permuted (interleaved_layer_order):
     callers that assign semantic meaning to stack positions (unstack
     converters, sequential fallbacks) must apply the permutation.
-    No with_aux / seq_axis / extra support (fail-loud; compose those
-    features with gpipe/1f1b)."""
+    ``extra`` matches gpipe's contract (per-microbatch metadata, e.g.
+    packed segment ids — every chunk-op indexes its microbatch's
+    slice, treated as non-differentiable; stage protocol becomes
+    ``stage_apply(chunk_params, x, extra_micro[, key])``).
+    No with_aux / seq_axis support (fail-loud; compose MoE/SP with
+    gpipe/1f1b)."""
     S = mesh.shape[axis_name]
     v = n_virtual
     if v < 2:
@@ -913,38 +917,46 @@ def interleaved(stage_apply: Callable, stacked_params, x, *,
     x_spec = P(data_axis, None, None)
     keyed = key is not None
     kk = key if keyed else jnp.zeros((2,), jnp.uint32)
+    has_extra = extra is not None
+    ex = extra if has_extra else jnp.zeros((0,), jnp.int32)
+    e_spec = P(data_axis) if has_extra else P()
     kw = dict(n_micro=n_micro, n_virtual=v, n_stages=S,
-              axis_name=axis_name, data_axis=data_axis, keyed=keyed)
+              axis_name=axis_name, data_axis=data_axis, keyed=keyed,
+              has_extra=has_extra)
 
-    def fwd_program(params, xx, k):
+    def fwd_program(params, xx, exx, k):
         body = functools.partial(_ileave_fwd_body, stage_apply, **kw)
         return jax.shard_map(
-            body, mesh=mesh, in_specs=(p_specs, x_spec, P()),
-            out_specs=x_spec, check_vma=False)(params, xx, k)
+            body, mesh=mesh, in_specs=(p_specs, x_spec, e_spec, P()),
+            out_specs=x_spec, check_vma=False)(params, xx, exx, k)
 
-    def bwd_program(params, xx, k, dy):
+    def bwd_program(params, xx, exx, k, dy):
         body = functools.partial(_ileave_bwd_body, stage_apply,
                                  sched=sched, **kw)
         return jax.shard_map(
-            body, mesh=mesh, in_specs=(p_specs, x_spec, P(), x_spec),
+            body, mesh=mesh,
+            in_specs=(p_specs, x_spec, e_spec, P(), x_spec),
             out_specs=(p_specs, x_spec), check_vma=False)(
-                params, xx, k, dy)
+                params, xx, exx, k, dy)
 
     @jax.custom_vjp
-    def run(params, xx, k):
-        return fwd_program(params, xx, k)
+    def run(params, xx, exx, k):
+        return fwd_program(params, xx, exx, k)
 
-    def run_fwd(params, xx, k):
-        return fwd_program(params, xx, k), (params, xx, k)
+    def run_fwd(params, xx, exx, k):
+        return fwd_program(params, xx, exx, k), (params, xx, exx, k)
 
     def run_bwd(res, dy):
-        params, xx, k = res
-        dparams, dx = bwd_program(params, xx, k, dy)
+        params, xx, exx, k = res
+        dparams, dx = bwd_program(params, xx, exx, k, dy)
         dk = np.zeros(np.shape(k), dtype=jax.dtypes.float0)
-        return dparams, dx, dk
+        dex = (np.zeros(np.shape(exx), dtype=jax.dtypes.float0)
+               if jnp.issubdtype(exx.dtype, jnp.integer)
+               else jnp.zeros_like(exx))
+        return dparams, dx, dex, dk
 
     run.defvjp(run_fwd, run_bwd)
-    return run(stacked_params, x, kk)
+    return run(stacked_params, x, ex, kk)
 
 
 def _ileave_chunks(local_params, v):
@@ -961,26 +973,35 @@ def _ileave_chunk_params(chunks, j):
         chunks)
 
 
-def _ileave_run(stage_apply, cp, x, m, g, key, keyed):
+def _ileave_run(stage_apply, cp, x, m, g, key, keyed, em=None):
     """Apply one chunk with the key folded per (microbatch, global
     stage). The ONE fold location: forward body, backward replay and
     the backward's vjp'd function all route through here, so replayed
-    dropout masks match the primal bit-for-bit by construction."""
+    dropout masks match the primal bit-for-bit by construction.
+    ``em`` ([M, mb, ...] microbatched extra metadata): this
+    microbatch's slice is indexed here, keeping the extra protocol
+    in one place too."""
+    args = (cp, x)
+    if em is not None:
+        args += (jax.lax.dynamic_index_in_dim(em, m, 0,
+                                              keepdims=False),)
     if keyed:
         k = jax.random.fold_in(jax.random.fold_in(key, m), g)
-        return stage_apply(cp, x, k)
-    return stage_apply(cp, x)
+        return stage_apply(*args, k)
+    return stage_apply(*args)
 
 
-def _ileave_apply(stage_apply, chunks, j, x, m, s, S, key, keyed):
+def _ileave_apply(stage_apply, chunks, j, x, m, s, S, key, keyed,
+                  em=None):
     """Index chunk j and run it (see _ileave_run)."""
     cp = _ileave_chunk_params(chunks, j)
-    return cp, _ileave_run(stage_apply, cp, x, m, j * S + s, key, keyed)
+    return cp, _ileave_run(stage_apply, cp, x, m, j * S + s, key,
+                           keyed, em)
 
 
-def _ileave_fwd_body(stage_apply, local_params, xl, key, *, n_micro,
-                     n_virtual, n_stages, axis_name, data_axis,
-                     keyed):
+def _ileave_fwd_body(stage_apply, local_params, xl, exl, key, *,
+                     n_micro, n_virtual, n_stages, axis_name,
+                     data_axis, keyed, has_extra=False):
     """Dense circular forward: vM + S - 1 ticks, closed-form indices
     (interleaved_fwd_schedule), full-ring ppermute each tick."""
     s = jax.lax.axis_index(axis_name)
@@ -991,6 +1012,7 @@ def _ileave_fwd_body(stage_apply, local_params, xl, key, *, n_micro,
                          f"{M} microbatches")
     mb = bl // M
     xm = xl.reshape(M, mb, t, c)
+    em = (exl.reshape((M, mb) + exl.shape[1:]) if has_extra else None)
     chunks = _ileave_chunks(local_params, v)
     ring = [(i, (i + 1) % S) for i in range(S)]
 
@@ -1007,7 +1029,7 @@ def _ileave_fwd_body(stage_apply, local_params, xl, key, *, n_micro,
                                                      keepdims=False),
                         act_in)
         _, y = _ileave_apply(stage_apply, chunks, j, inp, m, s, S,
-                             key, keyed)
+                             key, keyed, em)
         y = jnp.where(valid, y, jnp.zeros_like(y))
         is_out = valid & (s == S - 1) & (j == v - 1)
         outbuf = jax.lax.dynamic_update_index_in_dim(
@@ -1028,9 +1050,9 @@ def _ileave_fwd_body(stage_apply, local_params, xl, key, *, n_micro,
     return outbuf.reshape(bl, t, c)
 
 
-def _ileave_bwd_body(stage_apply, local_params, xl, key, dyl, *, sched,
-                     n_micro, n_virtual, n_stages, axis_name,
-                     data_axis, keyed):
+def _ileave_bwd_body(stage_apply, local_params, xl, exl, key, dyl, *,
+                     sched, n_micro, n_virtual, n_stages, axis_name,
+                     data_axis, keyed, has_extra=False):
     """Combined replay/backward scan over the host-built table: per
     tick, store ring-delivered arrivals into their allocated slots,
     run this device's op (F replay saving its input to the residual
@@ -1041,6 +1063,7 @@ def _ileave_bwd_body(stage_apply, local_params, xl, key, dyl, *, sched,
     bl, t, c = xl.shape
     mb = bl // M
     xm = xl.reshape(M, mb, t, c)
+    em = (exl.reshape((M, mb) + exl.shape[1:]) if has_extra else None)
     dym = dyl.reshape(M, mb, t, c)
     chunks = _ileave_chunks(local_params, v)
     fwd_ring = [(i, (i + 1) % S) for i in range(S)]
@@ -1087,14 +1110,14 @@ def _ileave_bwd_body(stage_apply, local_params, xl, key, dyl, *, sched,
 
         def do_f(_):
             _, y = _ileave_apply(stage_apply, chunks, j, x_f, m, s, S,
-                                 key, keyed)
+                                 key, keyed, em)
             return y, jnp.zeros_like(x_f), zero_dp
 
         def do_b(_):
             cp = _ileave_chunk_params(chunks, j)
             _, pull = jax.vjp(
                 lambda c, xi: _ileave_run(stage_apply, c, xi, m,
-                                          j * S + s, key, keyed),
+                                          j * S + s, key, keyed, em),
                 cp, x_b)
             dp, dx = pull(g_in)
             return jnp.zeros_like(x_b), dx, dp
