@@ -471,19 +471,27 @@ class PipelinedLM(nn.Module):
             # stage j*S + d — chunk-PERMUTED storage,
             # interleaved_layer_order; to_transformer_lm_params takes
             # (pipe, virtual) to unstack such checkpoints). Packed
-            # segment ids ride the executor's `extra` input like the
-            # other schedules; MoE/SP compose with gpipe/1f1b —
-            # interleaved's contribution is the ~v-fold smaller
-            # bubble (create_model rejects those combinations).
-            if moe or sp:
+            # segment ids ride the executor's `extra` input and MoE
+            # composes too (chunks hold whole super-layers; aux via
+            # with_aux, EP via ep_axis + the uniform backward); SP
+            # stays with gpipe/1f1b — interleaved's contribution is
+            # the ~v-fold smaller bubble (create_model rejects it).
+            if sp:
                 raise ValueError(
-                    "pp_schedule='interleaved' supports dense/flash "
-                    "blocks (packed included) — compose MoE/SP with "
-                    "gpipe/1f1b")
+                    "pp_schedule='interleaved' does not compose with "
+                    "SP attention — use gpipe/1f1b for dp x sp x pp")
+            pspecs = None
+            kw = {}
+            if ep_axis is not None:
+                from tpunet.parallel.tp import pp_stack_spec
+                pspecs = {kk: pp_stack_spec("blocks_" + kk)
+                          for kk in blocks}
+                kw["ep_axis"] = ep_axis
             x = interleaved(stage_apply, blocks, x, mesh=self.mesh,
                             n_micro=self.n_micro,
                             n_virtual=self.virtual, key=key,
-                            extra=segment_ids)
+                            extra=segment_ids, with_aux=moe,
+                            param_specs=pspecs, **kw)
         elif pipelined:
             executor = onef1b if self.schedule == "1f1b" else gpipe
             pspecs = None
@@ -549,10 +557,23 @@ def to_transformer_lm_params(params: dict, *, pipe: int = None,
            "ln": params["ln"]}
     L = params["blocks_qkv_k"].shape[0]
     if pipe is not None:
+        # Invert the chunk permutation per stack granularity: block
+        # stacks at layer granularity [L], MoE stacks at super-layer
+        # granularity [G] (chunks hold whole super-layers), dense-fc
+        # stacks at [G * (m_every - 1)] expanded from the G ordering.
         order = interleaved_layer_order(L, pipe, virtual)
-        inv = sorted(range(L), key=order.__getitem__)
-        params = {k: (v[jnp.asarray(inv)]
-                      if k.startswith("blocks_") and v.shape[0] == L
+        invs = {L: sorted(range(L), key=order.__getitem__)}
+        if "blocks_moe_wi" in params:
+            G = params["blocks_moe_wi"].shape[0]
+            order_g = interleaved_layer_order(G, pipe, virtual)
+            inv_g = sorted(range(G), key=order_g.__getitem__)
+            invs[G] = inv_g
+            me = L // G
+            if me > 1:
+                invs[G * (me - 1)] = [g * (me - 1) + o for g in inv_g
+                                      for o in range(me - 1)]
+        params = {k: (v[jnp.asarray(invs[v.shape[0]])]
+                      if k.startswith("blocks_") and v.shape[0] in invs
                       else v)
                   for k, v in params.items()}
     moe = "blocks_moe_wi" in params
@@ -654,11 +675,19 @@ def create_model(cfg: ModelConfig, mesh=None) -> PipelinedLM:
                 f"divisible by the pipe axis ({stages}) — the "
                 "interleaved F-stream cycles chunks per "
                 "stage-count-sized microbatch group")
-        if cfg.moe_experts > 0 or cfg.attention in ("ulysses", "ring"):
+        if cfg.attention in ("ulysses", "ring"):
             raise ValueError(
-                "pp_schedule='interleaved' composes with dense/flash "
-                "blocks only (no MoE, no SP) — use gpipe/1f1b for "
-                "those compositions")
+                "pp_schedule='interleaved' does not compose with SP "
+                "attention (ulysses/ring) — use gpipe/1f1b for "
+                "dp x sp x pp")
+        if cfg.moe_experts > 0:
+            lc = cfg.vit_depth // (stages * cfg.pp_virtual)
+            if lc % cfg.moe_every:
+                raise ValueError(
+                    f"interleaved chunks hold {lc} layers "
+                    f"(depth {cfg.vit_depth} / {stages} stages / "
+                    f"{cfg.pp_virtual} virtual) — not whole "
+                    f"super-layers of moe_every={cfg.moe_every}")
     if mesh is not None:
         stages = mesh.shape.get("pipe", 1)
         if stages > 1 and cfg.vit_depth % stages:

@@ -871,7 +871,8 @@ def interleaved_bwd_schedule(S: int, M: int, v: int) -> dict:
 def interleaved(stage_apply: Callable, stacked_params, x, *,
                 mesh: Mesh, n_micro: int, n_virtual: int = 2,
                 axis_name: str = "pipe", data_axis: str = "data",
-                key=None, extra=None):
+                key=None, extra=None, with_aux: bool = False,
+                param_specs=None, ep_axis: str = None):
     """Interleaved-1F1B pipeline executor (module section comment).
 
     Contract differs from gpipe/onef1b in ONE way: ``stage_apply``
@@ -886,8 +887,18 @@ def interleaved(stage_apply: Callable, stacked_params, x, *,
     packed segment ids — every chunk-op indexes its microbatch's
     slice, treated as non-differentiable; stage protocol becomes
     ``stage_apply(chunk_params, x, extra_micro[, key])``).
-    No with_aux / seq_axis support (fail-loud; compose MoE/SP with
-    gpipe/1f1b)."""
+    ``with_aux`` matches gpipe's too (chunk returns (y, aux); the
+    executor returns (out, aux_total) = sum over chunk-ops, mean over
+    microbatches and data shards). ``param_specs`` / ``ep_axis``
+    (MoE/EP x interleaved): per-leaf spec overrides (expert stacks
+    P('pipe','model')) and the mesh axis the chunk bodies' expert
+    collectives run over — with ``ep_axis`` the backward switches to
+    the collective-uniform one-vjp-per-tick form (in-stage
+    collectives inside the diverging F/B cond corrupt gradients,
+    onef1b's documented trap) and speaks onef1b's
+    unreduced-cotangent convention (entering cotangent divided by
+    the axis size, every leaf completed at the end per its spec).
+    No seq_axis support (compose SP with gpipe/1f1b)."""
     S = mesh.shape[axis_name]
     v = n_virtual
     if v < 2:
@@ -912,8 +923,9 @@ def interleaved(stage_apply: Callable, stacked_params, x, *,
                 f"{v} chunks")
 
     sched = interleaved_bwd_schedule(S, n_micro, v)
-    p_specs = jax.tree_util.tree_map(lambda _: P(axis_name),
-                                     stacked_params)
+    p_specs = (param_specs if param_specs is not None else
+               jax.tree_util.tree_map(lambda _: P(axis_name),
+                                      stacked_params))
     x_spec = P(data_axis, None, None)
     keyed = key is not None
     kk = key if keyed else jnp.zeros((2,), jnp.uint32)
@@ -922,22 +934,24 @@ def interleaved(stage_apply: Callable, stacked_params, x, *,
     e_spec = P(data_axis) if has_extra else P()
     kw = dict(n_micro=n_micro, n_virtual=v, n_stages=S,
               axis_name=axis_name, data_axis=data_axis, keyed=keyed,
-              has_extra=has_extra)
+              has_extra=has_extra, with_aux=with_aux, ep_axis=ep_axis)
+    fwd_out_specs = (x_spec, P()) if with_aux else x_spec
 
     def fwd_program(params, xx, exx, k):
         body = functools.partial(_ileave_fwd_body, stage_apply, **kw)
         return jax.shard_map(
             body, mesh=mesh, in_specs=(p_specs, x_spec, e_spec, P()),
-            out_specs=x_spec, check_vma=False)(params, xx, exx, k)
+            out_specs=fwd_out_specs, check_vma=False)(params, xx, exx, k)
 
-    def bwd_program(params, xx, exx, k, dy):
+    def bwd_program(params, xx, exx, k, dy, daux):
         body = functools.partial(_ileave_bwd_body, stage_apply,
-                                 sched=sched, **kw)
+                                 sched=sched, param_specs=p_specs,
+                                 **kw)
         return jax.shard_map(
             body, mesh=mesh,
-            in_specs=(p_specs, x_spec, e_spec, P(), x_spec),
+            in_specs=(p_specs, x_spec, e_spec, P(), x_spec, P()),
             out_specs=(p_specs, x_spec), check_vma=False)(
-                params, xx, exx, k, dy)
+                params, xx, exx, k, dy, daux)
 
     @jax.custom_vjp
     def run(params, xx, exx, k):
@@ -946,9 +960,14 @@ def interleaved(stage_apply: Callable, stacked_params, x, *,
     def run_fwd(params, xx, exx, k):
         return fwd_program(params, xx, exx, k), (params, xx, exx, k)
 
-    def run_bwd(res, dy):
+    def run_bwd(res, ct):
         params, xx, exx, k = res
-        dparams, dx = bwd_program(params, xx, exx, k, dy)
+        if with_aux:
+            dy, daux = ct
+        else:
+            dy, daux = ct, jnp.zeros((), jnp.float32)
+        dparams, dx = bwd_program(params, xx, exx, k, dy,
+                                  daux.astype(jnp.float32))
         dk = np.zeros(np.shape(k), dtype=jax.dtypes.float0)
         dex = (np.zeros(np.shape(exx), dtype=jax.dtypes.float0)
                if jnp.issubdtype(exx.dtype, jnp.integer)
@@ -1001,9 +1020,13 @@ def _ileave_apply(stage_apply, chunks, j, x, m, s, S, key, keyed,
 
 def _ileave_fwd_body(stage_apply, local_params, xl, exl, key, *,
                      n_micro, n_virtual, n_stages, axis_name,
-                     data_axis, keyed, has_extra=False):
+                     data_axis, keyed, has_extra=False, with_aux=False,
+                     ep_axis=None):
     """Dense circular forward: vM + S - 1 ticks, closed-form indices
-    (interleaved_fwd_schedule), full-ring ppermute each tick."""
+    (interleaved_fwd_schedule), full-ring ppermute each tick. With
+    ``with_aux`` each chunk-op's scalar accumulates; the total is the
+    sum over all (device, chunk) ops and the mean over microbatches
+    and data shards — gpipe's aux semantics."""
     s = jax.lax.axis_index(axis_name)
     S, M, v = n_stages, n_micro, n_virtual
     bl, t, c = xl.shape
@@ -1017,7 +1040,7 @@ def _ileave_fwd_body(stage_apply, local_params, xl, exl, key, *,
     ring = [(i, (i + 1) % S) for i in range(S)]
 
     def tick(carry, t_):
-        act_in, outbuf = carry
+        act_in, outbuf, auxsum = carry
         k = t_ - s
         valid = (k >= 0) & (k < v * M)
         kc = jnp.clip(k, 0, v * M - 1)
@@ -1030,6 +1053,10 @@ def _ileave_fwd_body(stage_apply, local_params, xl, exl, key, *,
                         act_in)
         _, y = _ileave_apply(stage_apply, chunks, j, inp, m, s, S,
                              key, keyed, em)
+        if with_aux:
+            y, a = y
+            auxsum = auxsum + jnp.where(valid,
+                                        a.astype(jnp.float32), 0.0)
         y = jnp.where(valid, y, jnp.zeros_like(y))
         is_out = valid & (s == S - 1) & (j == v - 1)
         outbuf = jax.lax.dynamic_update_index_in_dim(
@@ -1038,26 +1065,40 @@ def _ileave_fwd_body(stage_apply, local_params, xl, exl, key, *,
                       jax.lax.dynamic_index_in_dim(outbuf, m, 0,
                                                    keepdims=False)),
             m, 0)
-        return (jax.lax.ppermute(y, axis_name, ring), outbuf), None
+        return (jax.lax.ppermute(y, axis_name, ring), outbuf,
+                auxsum), None
 
     act0 = jnp.zeros((mb, t, c), xl.dtype)
-    (_, outbuf), _ = jax.lax.scan(
-        tick, (act0, jnp.zeros_like(xm)),
+    (_, outbuf, auxsum), _ = jax.lax.scan(
+        tick, (act0, jnp.zeros_like(xm), jnp.zeros((), jnp.float32)),
         jnp.arange(v * M + S - 1))
     outbuf = jax.lax.psum(
         jnp.where(s == S - 1, outbuf, jnp.zeros_like(outbuf)),
         axis_name)
-    return outbuf.reshape(bl, t, c)
+    out = outbuf.reshape(bl, t, c)
+    if not with_aux:
+        return out
+    n_data = jax.lax.psum(1, data_axis)
+    aux = jax.lax.psum(jax.lax.psum(auxsum, axis_name), data_axis)
+    return out, aux / (M * n_data)
 
 
-def _ileave_bwd_body(stage_apply, local_params, xl, exl, key, dyl, *,
-                     sched, n_micro, n_virtual, n_stages, axis_name,
-                     data_axis, keyed, has_extra=False):
+def _ileave_bwd_body(stage_apply, local_params, xl, exl, key, dyl,
+                     dauxl=None, *, sched, n_micro, n_virtual,
+                     n_stages, axis_name, data_axis, keyed,
+                     has_extra=False, with_aux=False, ep_axis=None,
+                     param_specs=None):
     """Combined replay/backward scan over the host-built table: per
     tick, store ring-delivered arrivals into their allocated slots,
     run this device's op (F replay saving its input to the residual
     ring, or B vjp-ing the saved input against the arrived cotangent),
-    and ppermute both streams around the full ring."""
+    and ppermute both streams around the full ring. With ``ep_axis``
+    the chunk bodies contain expert collectives, so every tick runs
+    ONE vjp on a role-selected input (collective-uniform; the F/B
+    cond's diverging collectives corrupt gradients — onef1b's
+    documented trap) and the scan speaks the unreduced-cotangent
+    convention: entering cotangents divided by the axis size, every
+    leaf completed at the end per its spec (onef1b's ep notes)."""
     s = jax.lax.axis_index(axis_name)
     S, M, v = n_stages, n_micro, n_virtual
     bl, t, c = xl.shape
@@ -1065,6 +1106,13 @@ def _ileave_bwd_body(stage_apply, local_params, xl, exl, key, dyl, *,
     xm = xl.reshape(M, mb, t, c)
     em = (exl.reshape((M, mb) + exl.shape[1:]) if has_extra else None)
     dym = dyl.reshape(M, mb, t, c)
+    epn = jax.lax.psum(1, ep_axis) if ep_axis is not None else 1
+    if ep_axis is not None:
+        dym = dym / epn          # sums-to-truth shares (onef1b note)
+    if with_aux:
+        n_data = jax.lax.psum(1, data_axis)
+        aux_ct = dauxl.astype(jnp.float32) / (M * n_data * epn)
+    uniform = ep_axis is not None
     chunks = _ileave_chunks(local_params, v)
     fwd_ring = [(i, (i + 1) % S) for i in range(S)]
     bwd_ring = [((i + 1) % S, i) for i in range(S)]
@@ -1102,27 +1150,43 @@ def _ileave_bwd_body(stage_apply, local_params, xl, exl, key, dyl, *,
                          jax.lax.dynamic_index_in_dim(dym, m, 0,
                                                       keepdims=False),
                          load(arr_b, col["ab_read"]))
-        # 3. the op: collective-free chunk bodies, so the cheap
-        # cond schedule runs only the branch each tick needs (idle
-        # ticks land in do_b on zeros, masked below — onef1b's trick)
+        # 3. the op. Without ep collectives the cheap cond schedule
+        # runs only the branch each tick needs (idle ticks land in
+        # do_b on zeros, masked below — onef1b's trick); with them,
+        # ONE vjp per tick on a role-selected input keeps the
+        # collective sequence identical on every device every tick.
         zero_dp = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape[1:], p.dtype), chunks)
 
-        def do_f(_):
-            _, y = _ileave_apply(stage_apply, chunks, j, x_f, m, s, S,
-                                 key, keyed, em)
-            return y, jnp.zeros_like(x_f), zero_dp
+        def chunk_fn(c, xi):
+            return _ileave_run(stage_apply, c, xi, m, j * S + s,
+                               key, keyed, em)
 
-        def do_b(_):
+        def pull_ct(pull):
+            return pull((g_in, aux_ct) if with_aux else g_in)
+
+        if uniform:
+            inp = jnp.where(is_f, x_f, x_b)
             cp = _ileave_chunk_params(chunks, j)
-            _, pull = jax.vjp(
-                lambda c, xi: _ileave_run(stage_apply, c, xi, m,
-                                          j * S + s, key, keyed, em),
-                cp, x_b)
-            dp, dx = pull(g_in)
-            return jnp.zeros_like(x_b), dx, dp
+            y, pull = jax.vjp(chunk_fn, cp, inp)
+            if with_aux:
+                y = y[0]
+            dp, dx = pull_ct(pull)
+        else:
+            def do_f(_):
+                _, y = _ileave_apply(stage_apply, chunks, j, x_f, m,
+                                     s, S, key, keyed, em)
+                if with_aux:
+                    y = y[0]
+                return y, jnp.zeros_like(x_f), zero_dp
 
-        y, dx, dp = jax.lax.cond(is_f, do_f, do_b, None)
+            def do_b(_):
+                cp = _ileave_chunk_params(chunks, j)
+                _, pull = jax.vjp(chunk_fn, cp, x_b)
+                dp, dx = pull_ct(pull)
+                return jnp.zeros_like(x_b), dx, dp
+
+            y, dx, dp = jax.lax.cond(is_f, do_f, do_b, None)
         y = jnp.where(is_f, y, jnp.zeros_like(y))
         dx = jnp.where(is_b, dx, jnp.zeros_like(dx))
         # 4. bookkeeping
@@ -1156,14 +1220,35 @@ def _ileave_bwd_body(stage_apply, local_params, xl, exl, key, dyl, *,
     )
     (_, _, _, _, _, dpsum, dxbuf), _ = jax.lax.scan(
         tick, carry0, tbl)
+    # Stage-0 holds the real input cotangents; with ep the unreduced
+    # shares complete over the ep axis too (dx is ep-replicated).
+    dx_axes = ((axis_name,) if ep_axis is None
+               else (axis_name, ep_axis))
     dx = jax.lax.psum(
-        jnp.where(s == 0, dxbuf, jnp.zeros_like(dxbuf)), axis_name)
-    # chunk grads back to the [L/S, ...] stack; each data shard saw
-    # only its microbatches -> complete over 'data' (as in onef1b).
-    dparams = jax.tree_util.tree_map(
-        lambda acc, p: jax.lax.psum(
+        jnp.where(s == 0, dxbuf, jnp.zeros_like(dxbuf)), dx_axes)
+    # Chunk grads back to the [L/S, ...] stack; each data shard saw
+    # only its microbatches -> complete over 'data', and under ep over
+    # the ep axis for every leaf NOT sharded over it (ep-sharded
+    # expert stacks hold per-shard grads and must not mix) — exactly
+    # onef1b's leaf rule.
+    flat_p, treedef = jax.tree_util.tree_flatten(local_params)
+    flat_acc = jax.tree_util.tree_leaves(dpsum)
+    if param_specs is None or ep_axis is None:
+        flat_specs = [None] * len(flat_p)
+    else:
+        flat_specs = jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda vv: isinstance(vv, P))
+
+    def leaf_axes(spec):
+        if ep_axis is None or (spec is not None
+                               and ep_axis in tuple(spec)):
+            return (data_axis,)
+        return (data_axis, ep_axis)
+
+    dparams = treedef.unflatten([
+        jax.lax.psum(
             acc.reshape((acc.shape[0] * acc.shape[1],)
                         + acc.shape[2:]),
-            data_axis).astype(p.dtype),
-        dpsum, local_params)
+            leaf_axes(sp_)).astype(p.dtype)
+        for acc, p, sp_ in zip(flat_acc, flat_p, flat_specs)])
     return dparams, dx.reshape(bl, t, c)
