@@ -83,7 +83,7 @@ meshes (unmeasurable on this 1-chip environment; the dp x pp dryrun
 leg validates the program, not its scaling). With the dense core this
 was 132k tok/s.
 
-Schedule note: two executors (``--pp-schedule``). "gpipe" (default)
+Schedule note: three executors (``--pp-schedule``). "gpipe" (default)
 lets reverse-mode AD through the scan+ppermute emit the standard
 backward pipeline (all forwards, then all backwards — its residuals
 stack every per-tick intermediate). "1f1b" is the hand-written VJP
@@ -92,7 +92,14 @@ backwards interleaved per microbatch in 1F1B order, holding at most
 min(S, M) stage inputs live — the 1F1B activation bound — at the cost
 of one rematerialized stage forward per microbatch. Same grads
 (parity-tested), same bubble fraction; pick 1f1b when activation
-memory, not compute, is the binding constraint.
+memory, not compute, is the binding constraint. "interleaved" adds
+virtual pipeline stages (``--pp-virtual`` chunks per device on a full
+activation ring, chunk-permuted 'pipe' storage): ~v-fold smaller
+bubble at a 1F1B-style bounded memory cost (pp.py interleaved).
+Composes with packed sequences and MoE/EP (chunks hold whole
+super-layers); SP stays with gpipe/1f1b. Interleaved checkpoints
+persist their layout (resume guard + the best_meta.json serving
+sidecar) because the stacks are chunk-permuted.
 """
 
 from __future__ import annotations
