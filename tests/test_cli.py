@@ -46,6 +46,22 @@ def test_arg_overrides():
     assert cfg.checkpoint.resume and cfg.checkpoint.directory == "/tmp/x"
 
 
+def test_round4_flags_parse_and_default():
+    cfg = config_from_args([
+        "--preset", "serial", "--model", "lm_pp", "--dataset",
+        "synthetic_lm", "--moe-experts", "4", "--moe-dispatch",
+        "alltoall", "--vocab-ce", "sharded", "--pp-schedule",
+        "interleaved", "--pp-virtual", "4"])
+    assert cfg.model.moe_dispatch == "alltoall"
+    assert cfg.model.vocab_ce == "sharded"
+    assert cfg.model.pp_schedule == "interleaved"
+    assert cfg.model.pp_virtual == 4
+    dflt = config_from_args(["--preset", "serial"])
+    assert dflt.model.moe_dispatch == "auto"
+    assert dflt.model.vocab_ce == "auto"
+    assert dflt.model.pp_virtual == 2
+
+
 @pytest.mark.slow
 def test_train_cli_end_to_end(tmp_path):
     """python train.py on synthetic data: epoch lines in the reference
