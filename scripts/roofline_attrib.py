@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Per-op time attribution for the flagship bench step (VERDICT r4 #3).
+
+Traces the exact bench.py workload (MobileNetV2 @224, bf16, full train
+step: augment + fwd + bwd + Adam + metrics) with the JAX profiler on the
+real chip, converts the xplane with xprof's hlo_stats tool, and writes a
+measured per-op/per-category breakdown of where the step time goes —
+turning the round-4 "residual is unfused BN/elementwise traffic,
+sub-peak bandwidth, depthwise VPU time" *guess* into numbers.
+
+Usage: python scripts/roofline_attrib.py [--batch 512] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+import jax
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpunet.utils.cache import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache(os.path.join(REPO, ".jax_cache"))
+
+
+def build_step(per_chip_batch: int, image_size: int = 224):
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.data.cifar10 import synthetic_cifar10
+    from tpunet.parallel import shard_host_batch
+    from tpunet.train.loop import Trainer
+
+    # GLOBAL batch = per-chip x n_chips, matching bench.py's per-chip
+    # convention so the attribution and bench records compare 1:1 on
+    # any chip count.
+    batch = per_chip_batch * jax.device_count()
+    cfg = TrainConfig(
+        data=DataConfig(dataset="synthetic", batch_size=batch,
+                        image_size=image_size),
+        model=ModelConfig(),
+        optim=OptimConfig(),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    ds = synthetic_cifar10(n_train=2 * batch, n_test=batch)
+    trainer = Trainer(cfg, dataset=ds)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(batch, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, 10, size=batch).astype(np.int32)
+    gx, gy = shard_host_batch(trainer.mesh, x, y)
+    return trainer, gx, gy
+
+
+def sync(state):
+    jax.block_until_ready(state)
+    leaf = jax.tree_util.tree_leaves(state.params)[0]
+    return float(np.asarray(leaf.ravel()[0]))
+
+
+def trace_step(trainer, gx, gy, steps: int, trace_dir: str) -> float:
+    from tpunet.utils.prng import step_key
+
+    state = trainer.state
+    for i in range(3):
+        state, _ = trainer.train_step(state, gx, gy, step_key(0, i))
+    sync(state)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        for i in range(steps):
+            state, _ = trainer.train_step(state, gx, gy, step_key(0, 3 + i))
+        sync(state)
+    return time.perf_counter() - t0
+
+
+def hlo_stats(trace_dir: str):
+    """Parse the captured xplane into per-HLO-op row dicts via xprof's
+    hlo_stats tool (returns a gviz DataTable as JSON: cols + rows)."""
+    from xprof.convert import raw_to_tool_data as rtd
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert paths, f"no xplane under {trace_dir}"
+    data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
+    tab = json.loads(data.decode() if isinstance(data, bytes) else data)
+    labels = [c["label"] for c in tab["cols"]]
+    return [dict(zip(labels, [(c or {}).get("v") for c in r["c"]]))
+            for r in tab["rows"]]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "runs", "bench-roofline", "ATTRIB_r05.json"))
+    ap.add_argument("--keep-trace", action="store_true")
+    ap.add_argument("--from-trace", default=None,
+                    help="parse an existing trace dir instead of "
+                         "re-tracing (batch/steps must match how it "
+                         "was captured for the throughput numbers)")
+    args = ap.parse_args()
+
+    if args.from_trace:
+        trace_dir, wall, trainer = args.from_trace, None, None
+    else:
+        trainer, gx, gy = build_step(args.batch, args.image_size)
+        trace_dir = tempfile.mkdtemp(prefix="tpunet-roofline-trace-")
+        wall = trace_step(trainer, gx, gy, args.steps, trace_dir)
+        print(f"# traced {args.steps} steps in {wall:.2f}s "
+              f"({args.steps * args.batch / wall:.0f} img/s/chip, incl. "
+              "profiler overhead)", file=sys.stderr)
+
+    rows = hlo_stats(trace_dir)
+
+    def f(row, name, default=0.0):
+        v = row.get(name)
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return default
+
+    by_cat = {}
+    by_src = {}
+    bw_weighted = 0.0
+    hbm_time = 0.0
+    ops = []
+    for r in rows:
+        t = f(r, "Total self time (us)")
+        cat = r.get("HLO op category") or "?"
+        by_cat[cat] = by_cat.get(cat, 0.0) + t
+        # attribute to framework source (module/op) for actionability
+        src = (r.get("Framework op name") or "?").split("/")
+        src = "/".join(src[1:3]) if len(src) > 2 else "/".join(src)
+        by_src[src] = by_src.get(src, 0.0) + t
+        bw = f(r, "Measured memory BW (GiB/s)")
+        if r.get("Bound by") == "HBM":
+            hbm_time += t
+            bw_weighted += t * bw
+        ops.append((t, r))
+    total = sum(by_cat.values()) or 1.0
+    ops.sort(key=lambda x: -x[0])
+
+    def top(n):
+        return [
+            {"pct": round(100.0 * t / total, 2),
+             "us_per_step": round(t / args.steps, 1),
+             "category": r.get("HLO op category"),
+             "bound_by": r.get("Bound by"),
+             "measured_bw_gibs": round(f(r, "Measured memory BW (GiB/s)"), 1),
+             "gflops": round(f(r, "Model GFLOP/s"), 1),
+             "op": r.get("HLO op name"),
+             "source": (r.get("Framework op name") or "")[:140]}
+            for t, r in ops[:n]]
+
+    out = {
+        "batch_per_chip": args.batch,
+        "n_chips": jax.device_count(),
+        "steps_traced": args.steps,
+        "wall_seconds": wall and round(wall, 3),
+        "img_per_sec_per_chip_traced": wall and round(
+            args.steps * args.batch / wall, 1),
+        "device_kind": jax.devices()[0].device_kind,
+        "total_profiled_us_per_step": round(total / args.steps, 1),
+        "hbm_bound_time_pct": round(100.0 * hbm_time / total, 2),
+        "hbm_bound_mean_achieved_bw_gibs": round(
+            bw_weighted / hbm_time, 1) if hbm_time else None,
+        "by_category_pct": {
+            k: round(100.0 * v / total, 2)
+            for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1])},
+        "by_source_pct_top": {
+            k: round(100.0 * v / total, 2)
+            for k, v in sorted(by_src.items(), key=lambda kv: -kv[1])[:25]},
+        "top_ops": top(40),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fp:
+        json.dump(out, fp, indent=1)
+    print(json.dumps({k: out[k] for k in
+                      ("img_per_sec_per_chip_traced",
+                       "total_profiled_us_per_step",
+                       "hbm_bound_time_pct",
+                       "hbm_bound_mean_achieved_bw_gibs",
+                       "by_category_pct")}, indent=1))
+    print(f"# wrote {args.out}", file=sys.stderr)
+    if args.from_trace or args.keep_trace:
+        # Never delete a trace the CALLER owns (--from-trace) or asked
+        # to keep; only the tempdir this run created is cleaned up.
+        print(f"# trace kept at {trace_dir}", file=sys.stderr)
+    else:
+        import shutil
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    if trainer is not None:
+        trainer.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache, shared with tests/conftest.py and the
+# dryrun: the two controllers compile IDENTICAL programs, so whichever
+# wins the race warms the other (and any prior test run warms both).
+from tpunet.utils.cache import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
+
 
 def fsdp_lm_case():
     """(cfg, dataset) for the FSDP+grad-accum LM case — the ONE source
@@ -79,9 +86,83 @@ def packed_lm_case(tmp_dir=None):
     return cfg, text_lm_packed(path, seq_len=32)
 
 
+def _tree_equal(a, b):
+    """Bit-exact pytree equality, computed as a global computation (works
+    on cross-process sharded leaves: every controller runs the same
+    array_equal, whose scalar result is replicated)."""
+    import jax
+    import jax.numpy as jnp
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb:
+        return False
+    return all(bool(jnp.array_equal(x, y)) for x, y in zip(la, lb))
+
+
+def _state_data(state):
+    """The ARRAY fields of a TrainState: its own treedef also carries
+    apply_fn/tx as static aux data, which are different function objects
+    in different Trainer instances — comparing those would always
+    report inequality."""
+    return {"params": state.params, "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state, "step": state.step,
+            "ema_params": state.ema_params,
+            "ema_batch_stats": state.ema_batch_stats}
+
+
+def _ckpt_roundtrip(trainer, cfg, ds, train1):
+    """Multi-host orbax checkpointing under TRUE multi-controller: both
+    processes participate in one best-params save + one full-state save
+    into a SHARED directory, then a fresh Trainer resumes from it and
+    must match bit-exactly. The reference saves from rank 0 only
+    (cifar10_mpi_mobilenet_224.py:243-250); orbax instead coordinates
+    every host through the same save — the coordination (barrier
+    pairing, one consistent directory, no deadlock) is exactly what
+    this exercises."""
+    import dataclasses
+
+    from tpunet.train.loop import Trainer
+
+    trainer.best_acc = float(train1["accuracy"])
+    lay = trainer._pp_layout()
+    trainer.ckpt.save_best(
+        {"params": trainer.state.params,
+         "batch_stats": trainer.state.batch_stats},
+        meta={"model": cfg.model.name,
+              "pp_schedule": cfg.model.pp_schedule,
+              "pp_layout_pipe": int(lay[0]),
+              "pp_layout_virtual": int(lay[1])})
+    trainer.ckpt.save_state(1, trainer._payload())
+    trainer.ckpt.wait()
+
+    cfg2 = cfg.replace(checkpoint=dataclasses.replace(
+        cfg.checkpoint, resume=True))
+    t2 = Trainer(cfg2, dataset=ds)
+    try:
+        state_equal = _tree_equal(_state_data(trainer.state),
+                                  _state_data(t2.state))
+        best = t2.ckpt.restore_best({
+            "params": t2.state.params,
+            "batch_stats": t2.state.batch_stats})
+        best_equal = best is not None and _tree_equal(
+            trainer.state.params, best["params"])
+        meta = t2.ckpt.best_meta()
+        return {
+            "resume_epoch": t2.start_epoch,
+            "resume_best_acc": t2.best_acc,
+            "state_equal": state_equal,
+            "best_equal": best_equal,
+            "meta_model": meta["model"] if meta else None,
+        }
+    finally:
+        t2.close()
+
+
 def main():
     coordinator, num_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
+    ckpt_dir = sys.argv[5] if len(sys.argv) > 5 else None
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_procs,
@@ -114,17 +195,26 @@ def main():
             checkpoint=CheckpointConfig(save_best=False, save_last=False),
         )
         ds = synthetic_cifar10(n_train=64, n_test=32, seed=7)
+    if ckpt_dir:
+        # Shared directory from the parent: all controllers join the
+        # same multi-host orbax saves (and the round-trip below).
+        cfg = cfg.replace(checkpoint=CheckpointConfig(
+            directory=ckpt_dir, save_best=True, save_last=True))
     trainer = Trainer(cfg, dataset=ds)
     sync_hosts("start")
     eval0 = trainer.evaluate()
     train1 = trainer.train_one_epoch(0)
-    print(json.dumps({
+    out = {
         "process": pid,
         "world": jax.process_count(),
         "devices": jax.device_count(),
         "eval0": eval0,
         "train1": train1,
-    }), flush=True)
+    }
+    if ckpt_dir:
+        out["ckpt"] = _ckpt_roundtrip(trainer, cfg, ds, train1)
+    trainer.close()
+    print(json.dumps(out), flush=True)
     jax.distributed.shutdown()
 
 

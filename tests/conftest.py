@@ -23,15 +23,10 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: repeated Trainer/jit builds across test
 # files reuse compiled executables instead of re-tracing XLA each time.
-# Per-user path so shared machines don't collide; JAX's own env var wins.
-if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-    import getpass
-    import tempfile
-    _cache = os.path.join(tempfile.gettempdir(),
-                          f"tpunet-jax-cache-{getpass.getuser()}")
-    jax.config.update("jax_compilation_cache_dir", _cache)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# Shared convention (path + thresholds) lives in tpunet.utils.cache.
+from tpunet.utils.cache import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

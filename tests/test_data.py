@@ -130,6 +130,65 @@ def test_train_augment_shapes_determinism_and_randomness():
     assert not jnp.allclose(out[0], out[1])
 
 
+def test_shear_rotation_matches_gather_rotation():
+    """The 3-shear (Paeth) matmul rotation must reproduce the direct
+    4-tap bilinear gather rotation: identical at angle 0, and close on
+    smooth content at the pipeline's +-15 degrees (3 successive 1-D
+    interps blur marginally more than one 2-D bilinear, so the band is
+    loose on noise but tight on smooth images; geometry must agree —
+    that's what a wrong shear convention would break)."""
+    from tpunet.data.augment import _rotate_bilinear, _rotate_shear
+
+    yy, xx = np.meshgrid(np.linspace(0, 1, 32), np.linspace(0, 1, 32),
+                         indexing="ij")
+    smooth = np.stack([yy, xx, (yy + xx) / 2], -1).astype(np.float32)
+
+    out0 = _rotate_shear(jnp.asarray(smooth), jnp.float32(0.0))
+    np.testing.assert_allclose(np.asarray(out0), smooth, atol=1e-5)
+
+    for deg in (-15.0, 7.5, 15.0):
+        a = jnp.float32(np.deg2rad(deg))
+        ref = np.asarray(_rotate_bilinear(jnp.asarray(smooth), a,
+                                          fill="edge"))
+        got = np.asarray(_rotate_shear(jnp.asarray(smooth), a))
+        # interior only: the edge-clamp order differs in the corners
+        err = np.abs(ref - got)[4:-4, 4:-4]
+        assert err.max() < 0.02, (deg, err.max())
+        assert err.mean() < 0.003, (deg, err.mean())
+
+
+def test_augment_large_rotation_uses_exact_path(monkeypatch):
+    """rotation_degrees > 30 must dispatch the direct 4-tap gather
+    rotation (the shear decomposition's edge clamps smear content
+    there), <= 30 the shear path — asserted by counting which
+    implementation each config actually traces."""
+    import dataclasses
+
+    from tpunet.data import augment as A
+
+    calls = {"shear": 0, "gather": 0}
+    real_shear, real_gather = A._rotate_shear, A._rotate_bilinear
+    monkeypatch.setattr(A, "_rotate_shear", lambda *a, **k: (
+        calls.__setitem__("shear", calls["shear"] + 1),
+        real_shear(*a, **k))[1])
+    monkeypatch.setattr(A, "_rotate_bilinear", lambda *a, **k: (
+        calls.__setitem__("gather", calls["gather"] + 1),
+        real_gather(*a, **k))[1])
+
+    imgs = jnp.asarray(np.random.default_rng(2).integers(
+        0, 256, size=(4, 32, 32, 3), dtype=np.uint8))
+
+    big = dataclasses.replace(SMALL, rotation_degrees=60.0)
+    out = jax.jit(A.make_train_augment(big))(jax.random.PRNGKey(5), imgs)
+    assert calls == {"shear": 0, "gather": 1}, calls
+    assert out.shape == (4, 64, 64, 3)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+    small = dataclasses.replace(SMALL, rotation_degrees=15.0)
+    jax.jit(A.make_train_augment(small))(jax.random.PRNGKey(5), imgs)
+    assert calls == {"shear": 1, "gather": 1}, calls
+
+
 def test_augment_values_in_normalized_range():
     aug = jax.jit(make_train_augment(SMALL))
     imgs = jnp.asarray(np.random.default_rng(1).integers(

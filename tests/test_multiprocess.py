@@ -9,11 +9,7 @@ both controllers report identical *global* metrics, and those metrics
 match a single-process run on the same global mesh.
 """
 
-import json
 import os
-import socket
-import subprocess
-import sys
 
 import numpy as np
 import pytest
@@ -22,42 +18,16 @@ HERE = os.path.dirname(__file__)
 REPO = os.path.dirname(HERE)
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def _run_workers(mode=None, timeout=600, ckpt_dir=None):
+    """Launch the two worker controllers via the shared gang launcher
+    (tests/_gang.py — one home for the launch/drain protocol, shared
+    with the driver dryrun's leg 8)."""
+    from _gang import launch_gang
 
-
-
-def _run_workers(mode=None, timeout=600):
-    """Launch the two worker controllers and return their parsed JSON
-    outputs; workers are killed on ANY failure (a rendezvous deadlock
-    must not outlive the test)."""
-    port = _free_port()
-    coordinator = f"127.0.0.1:{port}"
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     argv_tail = [mode] if mode else []
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(HERE, "_mp_worker.py"),
-             coordinator, "2", str(pid)] + argv_tail,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True, env=env, cwd=REPO)
-        for pid in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=timeout)
-            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    return outs
+    if ckpt_dir:
+        argv_tail = [mode or "dp", str(ckpt_dir)]
+    return launch_gang(argv_tail, timeout=timeout)
 
 
 @pytest.mark.slow
@@ -116,6 +86,30 @@ def test_two_process_packed_lm():
         assert np.isclose(m["loss"], a["train1"]["loss"], rtol=2e-2)
     finally:
         t.close()
+
+
+@pytest.mark.slow
+def test_two_process_checkpoint_roundtrip(tmp_path):
+    """Multi-host orbax checkpointing under TRUE multi-controller, on
+    the FSDP case (params + Adam moments sharded over the cross-process
+    data axis — each controller holds only half of every leaf): both
+    controllers join one best-params save + one full-state save into a
+    shared directory, and a fresh Trainer in each process resumes from
+    it bit-exactly. The save/restore coordination itself (orbax barrier
+    pairing, one consistent directory, no deadlock, no rank-local
+    partial write) is what's under test — the reference's rank-0-only
+    torch.save has no analogue for sharded state
+    (cifar10_mpi_mobilenet_224.py:243-250)."""
+    a, b = _run_workers(mode="fsdp_lm", ckpt_dir=tmp_path / "ckpt")
+    for o in (a, b):
+        assert o["ckpt"]["resume_epoch"] == 2, o["ckpt"]
+        assert o["ckpt"]["state_equal"], o["ckpt"]
+        assert o["ckpt"]["best_equal"], o["ckpt"]
+        assert o["ckpt"]["meta_model"] == "lm", o["ckpt"]
+    assert np.isclose(a["ckpt"]["resume_best_acc"],
+                      b["ckpt"]["resume_best_acc"])
+    assert np.isclose(a["ckpt"]["resume_best_acc"],
+                      a["train1"]["accuracy"])
 
 
 @pytest.mark.slow

@@ -119,6 +119,19 @@ class Checkpointer:
         if not self.cfg.save_last:
             return
         snap = _snapshot(payload)
+        # The manager is created INSIDE the worker lambda on purpose:
+        # CheckpointManager.__init__ runs a cross-host barrier
+        # (sync_global_processes), so on multi-host it must stay
+        # serialized with every other orbax collective on the ONE
+        # worker thread — creating it on the caller thread while a
+        # background best-save is mid-barrier interleaves the two
+        # barrier sequences differently per process ("sync_global_
+        # devices name mismatch", caught by test_two_process_
+        # checkpoint_roundtrip). The observability race this once
+        # suggested (saving_in_progress() reading self._mgr mid-
+        # construction) is covered by its pending-futures check: the
+        # submitted future is not done while the manager is being
+        # built.
         self._submit(lambda: self.manager.save(
             step, args=ocp.args.StandardSave(snap)))
 
@@ -135,6 +148,37 @@ class Checkpointer:
         step = step if step is not None else self.manager.latest_step()
         if step is None:
             return None
+        # Restore only the keys the checkpoint actually HAS: payloads
+        # grow keys across versions (e.g. pp_layout), and StandardRestore
+        # raises opaquely on a target leaf the save never wrote. Filtering
+        # here lets the caller's restored.get(key, default) handle older
+        # checkpoints gracefully.
+        try:
+            meta = self.manager.item_metadata(step)
+            tree = getattr(meta, "tree", None) if meta is not None else None
+            if tree is None:
+                # Fresh manager (no save/restore yet in this process):
+                # item_metadata can't infer the handler — read the tree
+                # structure straight off the step's default item.
+                with ocp.Checkpointer(
+                        ocp.StandardCheckpointHandler()) as probe:
+                    sm = probe.metadata(
+                        os.path.join(str(self.manager.directory),
+                                     str(step), "default"))
+                tree = getattr(getattr(sm, "item_metadata", None),
+                               "tree", None)
+            if isinstance(tree, dict) and isinstance(target, dict):
+                target = {k: v for k, v in target.items() if k in tree}
+        except Exception as e:
+            # Best-effort — restore decides. But LOG it: on multi-host,
+            # one controller's probe failing while the others' succeed
+            # means asymmetric restore targets (a missing-leaf raise on
+            # one host vs a barrier wait on the rest); the message is
+            # the breadcrumb that makes that diagnosable.
+            import logging
+            logging.getLogger(__name__).warning(
+                "checkpoint metadata probe failed (restoring with the "
+                "full target): %s", e)
         return self.manager.restore(
             step, args=ocp.args.StandardRestore(target))
 
@@ -149,22 +193,54 @@ class Checkpointer:
         meta_path = os.path.join(self.directory, "best_meta.json")
 
         def write():
-            self._best.save(path, snap, force=True)
+            wrote_sidecar = prev = None
             if meta is not None and jax.process_index() == 0:
                 # Sidecar layout metadata (JSON, human-readable): lets
                 # serving recover e.g. the interleaved schedule's
                 # chunk permutation without operator-remembered flags
-                # (tpunet/infer/generate.py load_lm).
+                # (tpunet/infer/generate.py load_lm). Written BEFORE
+                # the orbax save so the save's cross-host commit
+                # barrier orders it: any process that observes the new
+                # best/ (via wait()/restore_best()) also observes the
+                # matching sidecar — writing it after would let a
+                # non-zero host pair fresh params with a stale sidecar
+                # whenever process 0's worker thread lags the barrier.
                 import json
+                # The sidecar may be the FIRST write under directory
+                # (the orbax save that used to create it now runs
+                # after us).
+                os.makedirs(self.directory, exist_ok=True)
+                if os.path.isfile(meta_path):
+                    with open(meta_path) as f:
+                        prev = f.read()
                 tmp = meta_path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(meta, f, indent=1)
                 os.replace(tmp, meta_path)
+                wrote_sidecar = True
+            try:
+                self._best.save(path, snap, force=True)
+            except BaseException:
+                # Roll the sidecar back: a failed best-save must not
+                # leave a NEW sidecar durably paired with the OLD
+                # best/ params (serving would trust its pp_layout and
+                # mis-permute the old stack).
+                if wrote_sidecar:
+                    if prev is None:
+                        os.unlink(meta_path)
+                    else:
+                        tmp = meta_path + ".tmp"
+                        with open(tmp, "w") as f:
+                            f.write(prev)
+                        os.replace(tmp, meta_path)
+                raise
 
         self._submit(write)
 
     def best_meta(self) -> Optional[Dict[str, Any]]:
         """The sidecar metadata written alongside best/, or None."""
+        self._drain()  # like restore_best: never pair new params with a
+        # stale sidecar while a save_best is still queued behind us
         path = os.path.join(self.directory, "best_meta.json")
         if not os.path.isfile(path):
             return None

@@ -59,6 +59,18 @@ SRC = 32  # CIFAR-10 native resolution
 # Bilinear resampling as separable matrices (MXU path)
 # ---------------------------------------------------------------------------
 
+def _hat_weights(s, src_size: int):
+    """[..., out] continuous source coords -> [..., out, src] bilinear
+    hat weights, coords clamped to the frame. The ONE home of the
+    clamped-tap convention: after the clamp both adjacent taps exist
+    and their weights sum to exactly (1-f) + f = 1, so no normalizing
+    reduction is needed (it showed up at ~3% of the train step in the
+    round-5 per-op profile, runs/bench-roofline/)."""
+    s = jnp.clip(s, 0.0, src_size - 1.0)
+    j = jnp.arange(src_size, dtype=jnp.float32)
+    return jnp.maximum(0.0, 1.0 - jnp.abs(s[..., None] - j))
+
+
 def _bilinear_matrix(start, size, out_size: int, src_size: int):
     """(out_size, src_size) bilinear sampling matrix for a 1-D crop+resize.
 
@@ -67,11 +79,8 @@ def _bilinear_matrix(start, size, out_size: int, src_size: int):
     ``start``/``size`` may be traced scalars — the matrix shape is static.
     """
     i = jnp.arange(out_size, dtype=jnp.float32)
-    s = start + (i + 0.5) * size / out_size - 0.5
-    s = jnp.clip(s, 0.0, src_size - 1.0)
-    j = jnp.arange(src_size, dtype=jnp.float32)
-    w = jnp.maximum(0.0, 1.0 - jnp.abs(s[:, None] - j[None, :]))
-    return w / jnp.sum(w, axis=1, keepdims=True)
+    return _hat_weights(start + (i + 0.5) * size / out_size - 0.5,
+                        src_size)
 
 
 def resize_matrix_np(out_size: int, src_size: int) -> np.ndarray:
@@ -134,6 +143,43 @@ def _rotate_bilinear(img, angle, fill: str = "zero"):
     top = gather(y0, x0) * (1 - wx) + gather(y0, x0 + 1) * wx
     bot = gather(y0 + 1, x0) * (1 - wx) + gather(y0 + 1, x0 + 1) * wx
     return top * (1 - wy) + bot * wy
+
+
+def _shear_mats(shifts, size: int):
+    """[L] per-line shifts -> [L, size, size] bank of 1-D bilinear
+    shift-with-edge-clamp matrices (line l's resample is ``M[l] @
+    line``); weights/clamp via the shared ``_hat_weights``, whose
+    clamp doubles as the edge fill."""
+    i = jnp.arange(size, dtype=jnp.float32)
+    return _hat_weights(i[None, :] + shifts[:, None], size)
+
+
+def _rotate_shear(img, angle):
+    """Rotate (H, W, C) by ``angle`` radians via the 3-shear (Paeth)
+    decomposition, edge fill — the TPU-native replacement for the
+    4-tap gather rotation on the train path.
+
+    rotate(a) = shear_x(t) . shear_y(s) . shear_x(t) with
+    t = -tan(a/2), s = sin(a) (all about the image center, matching
+    ``_inverse_rot_coords``'s convention — verified against
+    ``_rotate_bilinear`` in tests/test_data.py). Each shear is a bank
+    of per-line 32x32 resample matrices applied as batched matmuls:
+    under vmap the whole rotation is 3 einsums on the MXU, replacing
+    the 4 vmapped gathers that ran at 3-4 GiB/s and cost 15% of the
+    train step (runs/bench-roofline/ATTRIB_r05.json). Three successive
+    1-D interpolations blur marginally more than one 2-D bilinear —
+    distribution-level equivalent (test_augment_stats holds), and the
+    torchvision border geometry is untouched (the analytic coverage
+    mask below is angle-only)."""
+    h, w = img.shape[0], img.shape[1]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    t = -jnp.tan(angle / 2.0)
+    s = jnp.sin(angle)
+    mx = _shear_mats(t * (jnp.arange(h, dtype=jnp.float32) - cy), w)
+    my = _shear_mats(s * (jnp.arange(w, dtype=jnp.float32) - cx), h)
+    img = jnp.einsum("hij,hjc->hic", mx, img)   # x-shear
+    img = jnp.einsum("wij,jwc->iwc", my, img)   # y-shear
+    return jnp.einsum("hij,hjc->hic", mx, img)  # x-shear (same bank)
 
 
 def _rotation_border_mask(size: int, angle):
@@ -253,13 +299,33 @@ def _augment_one(key, img_u8, cfg: DataConfig):
         angle = jax.random.uniform(
             kr, (), minval=-cfg.rotation_degrees,
             maxval=cfg.rotation_degrees) * (math.pi / 180.0)
-        # Content rotation at the 32px SOURCE (tiny gather), edge fill.
-        x = _rotate_bilinear(x, angle, fill="edge")
+        # Content rotation at the 32px SOURCE, edge fill. The 3-shear
+        # matmul path (gather-free, see _rotate_shear) is exact only
+        # while the intermediate shears stay inside the frame; beyond
+        # ~30 degrees their edge clamps start smearing content, so
+        # larger configured ranges keep the direct 4-tap gather
+        # (rotation_degrees is static — the choice is made at trace
+        # time, not per angle).
+        if cfg.rotation_degrees <= 30.0:
+            x = _rotate_shear(x, angle)
+        else:
+            x = _rotate_bilinear(x, angle, fill="edge")
+    # Color jitter at the 32px SOURCE, before the crop+resize: every
+    # jitter pass (and its clips/reductions) touches a 49x smaller
+    # tensor than at 224 (measured ~5% of the train step there,
+    # runs/bench-roofline/ATTRIB_r05.json). Jitter is per-pixel and
+    # bilinear resampling is a convex combination, so brightness/
+    # saturation commute with the resize exactly up to the clip;
+    # contrast's gray-mean is now over the full source rather than the
+    # crop, and hue's nonlinearity interpolates slightly differently —
+    # distribution-level equivalent (test_augment_stats' PIL bands
+    # hold), and the jitter-vs-crop order was already a documented
+    # deviation from torchvision.
+    x = _color_jitter(kj, x, cfg)
     top, left, h, w = _rrc_params(kc, cfg)
     row_m = _bilinear_matrix(top, h, cfg.image_size, SRC)
     col_m = _bilinear_matrix(left, w, cfg.image_size, SRC)
     x = _apply_separable(x, row_m, col_m)
-    x = _color_jitter(kj, x, cfg)
     if cfg.rotation_degrees > 0:
         # torchvision rotates LAST, leaving black corners on the full
         # output frame — reproduced here as the closed-form coverage

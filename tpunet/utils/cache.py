@@ -1,0 +1,46 @@
+"""Shared persistent-compile-cache convention.
+
+ONE home for the cache path and thresholds: tests/conftest.py,
+tests/_mp_worker.py and __graft_entry__.py all call this, so every
+entry point reads and warms the SAME per-user cache directory —
+cross-process warm-cache hits (two multi-controller workers compiling
+identical programs; a dryrun following a test run) depend on the
+convention never diverging between copies.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import tempfile
+
+
+def cache_dir() -> str:
+    """The shared cache directory (honoring JAX's own env var) — also
+    what subprocess launchers export as JAX_COMPILATION_CACHE_DIR."""
+    return os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        tempfile.gettempdir(), f"tpunet-jax-cache-{getpass.getuser()}")
+
+
+def enable_persistent_compile_cache(directory: str | None = None) -> None:
+    """Point JAX's compiled-program cache at a shared per-user dir.
+
+    JAX's own ``JAX_COMPILATION_CACHE_DIR`` env var wins when set (the
+    operator relocated the cache); thresholds are lowered so every
+    Trainer program is cached, not just multi-second compiles. Call
+    AFTER jax is importable, BEFORE the first compile.
+
+    ``directory`` overrides the default per-user tempdir (still losing
+    to the env var) — the TPU entry points (bench.py, scripts/
+    roofline_attrib.py) pass the repo-local ``.jax_cache``, which
+    survives tempdir cleanup between sessions; remote-relay TPU
+    compiles are expensive enough to deserve the more durable home.
+    """
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR") or directory
+        or cache_dir())
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
