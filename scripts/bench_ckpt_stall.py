@@ -92,13 +92,18 @@ def main():
             mean(t_on[1:]) - mean(t_off[1:]), 4),
         "final_drain_seconds": round(drain, 4),
         "note": "fully-async saves (tpunet/ckpt/orbax_io.py): the "
-                "step loop pays only dispatch_seconds (on-device "
-                "snapshot + worker handoff, ~0.3s steady vs ~1.0s "
-                "blocking + 13s first-save before); orbax's blocking "
-                "phase + serialization + IO run on a background "
-                "worker behind the next epoch, with >1-outstanding "
-                "back-pressure bounding snapshot memory. The write "
-                "residue surfaces as final_drain_seconds at wait().",
+                "step loop pays dispatch_seconds (on-device snapshot "
+                "+ worker handoff; measured 0.24-0.47s when the "
+                "writer keeps up, vs ~1.0s blocking + 13s first-save "
+                "before async). On a 1-core host the background "
+                "writer COMPETES with the step loop, so when epochs "
+                "are shorter than the write the >1-outstanding "
+                "back-pressure (by design, bounding snapshot HBM) "
+                "surfaces as multi-second dispatch stalls - the "
+                "mean_dispatch here includes them; with a spare host "
+                "core the steady figure is the honest expectation. "
+                "The write residue surfaces as final_drain_seconds "
+                "at wait().",
     }
     os.makedirs(args.out, exist_ok=True)
     path = os.path.join(args.out, "STALL.json")
