@@ -118,6 +118,54 @@ def test_best_meta_reads_latest_after_async_save(tmp_path):
         ckpt.close()
 
 
+def test_restore_survives_metadata_probe_failure(tmp_path, caplog):
+    """If the tree-metadata probe fails, restore proceeds with the FULL
+    target (correct for non-legacy checkpoints) and logs the swallowed
+    error — on multi-host, one controller probing differently from the
+    others is only diagnosable from that breadcrumb."""
+    import logging
+
+    import jax.numpy as jnp
+
+    from tpunet.ckpt.orbax_io import Checkpointer
+
+    payload = {"state": {"w": jnp.arange(4.0)},
+               "epoch": np.asarray(1, np.int32)}
+    ck = Checkpointer(CheckpointConfig(directory=str(tmp_path),
+                                       save_best=False, save_last=True))
+    ck2 = Checkpointer(CheckpointConfig(directory=str(tmp_path),
+                                        save_best=False, save_last=True))
+    try:
+        ck.save_state(1, payload)
+        ck.wait()
+        ck2.manager.item_metadata = lambda step: (_ for _ in ()).throw(
+            RuntimeError("probe boom"))
+        with caplog.at_level(logging.WARNING,
+                             logger="tpunet.ckpt.orbax_io"):
+            restored = ck2.restore_state(
+                {"state": {"w": jnp.zeros(4)},
+                 "epoch": np.asarray(0, np.int32)})
+        assert restored is not None
+        np.testing.assert_array_equal(np.asarray(restored["state"]["w"]),
+                                      np.arange(4.0))
+        assert any("metadata probe failed" in r.message
+                   for r in caplog.records)
+    finally:
+        ck.close()
+        ck2.close()
+
+
+def test_cache_dir_honors_jax_env_var(monkeypatch):
+    """The shared compile-cache convention: JAX's own env var wins;
+    otherwise the per-user tempdir path."""
+    from tpunet.utils.cache import cache_dir
+
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/elsewhere/cache")
+    assert cache_dir() == "/elsewhere/cache"
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+    assert "tpunet-jax-cache-" in cache_dir()
+
+
 def test_failed_best_save_rolls_back_sidecar(tmp_path):
     """The sidecar commits before the orbax save (multi-host ordering);
     if the save then FAILS, the sidecar must roll back — a new layout
