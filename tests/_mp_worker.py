@@ -11,20 +11,28 @@ import json
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Worker-process environment ONLY: tests import this module for its
+# *_case() config factories, and mutating XLA_FLAGS at import time
+# would silently re-initialize the IMPORTING process's backend with 4
+# devices (a solo `pytest tests/test_multiprocess.py::<one test>` hit
+# exactly that).
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
 
-# Persistent compile cache, shared with tests/conftest.py and the
-# dryrun: the two controllers compile IDENTICAL programs, so whichever
-# wins the race warms the other (and any prior test run warms both).
-from tpunet.utils.cache import enable_persistent_compile_cache  # noqa: E402
+    # Persistent compile cache, shared with tests/conftest.py and the
+    # dryrun: the two controllers compile IDENTICAL programs, so
+    # whichever wins the race warms the other (and any prior test run
+    # warms both).
+    from tpunet.utils.cache import enable_persistent_compile_cache
 
-enable_persistent_compile_cache()
+    enable_persistent_compile_cache()
 
 
 def fsdp_lm_case():
@@ -46,6 +54,33 @@ def fsdp_lm_case():
                           max_seq_len=32),
         optim=OptimConfig(learning_rate=3e-3, grad_accum=2),
         mesh=MeshConfig(fsdp=True),
+        checkpoint=CheckpointConfig(save_best=False, save_last=False),
+    )
+    return cfg, synthetic_lm(64, 32, seq_len=32, vocab=32, seed=7)
+
+
+def pp_lm_case():
+    """(cfg, dataset) for the PIPELINED LM case under multi-controller:
+    the 1F1B executor's shard_map (activation ppermutes over 'pipe',
+    microbatch scheduling, the manual VJP) spans a mesh whose 'data'
+    axis crosses the process boundary — the closest analogue of the
+    reference's multi-node pipeline story (its DDP is single-axis;
+    this is schedule + cross-process sharding together)."""
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.data.lm import synthetic_lm
+
+    cfg = TrainConfig(
+        epochs=1, seed=42,
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        seq_len=32, vocab_size=32),
+        model=ModelConfig(name="lm_pp", vit_hidden=64, vit_depth=4,
+                          vit_heads=4, dropout_rate=0.0,
+                          dtype="float32", vocab_size=32,
+                          max_seq_len=32, pp_microbatches=2,
+                          pp_schedule="1f1b"),
+        optim=OptimConfig(learning_rate=3e-3),
+        mesh=MeshConfig(data=4, pipe=2),
         checkpoint=CheckpointConfig(save_best=False, save_last=False),
     )
     return cfg, synthetic_lm(64, 32, seq_len=32, vocab=32, seed=7)
@@ -179,6 +214,8 @@ def main():
 
     if mode == "fsdp_lm":
         cfg, ds = fsdp_lm_case()
+    elif mode == "pp_lm":
+        cfg, ds = pp_lm_case()
     elif mode == "packed_lm":
         cfg, ds = packed_lm_case()
     else:

@@ -89,6 +89,34 @@ def test_two_process_packed_lm():
 
 
 @pytest.mark.slow
+def test_two_process_pipeline_lm():
+    """The 1F1B pipeline executor under TRUE multi-controller: its
+    shard_map (activation ppermutes over 'pipe', microbatch schedule,
+    manual VJP) runs on a dp4 x pp2 mesh whose data axis crosses the
+    process boundary. Both controllers must agree on global metrics
+    and match a single-process run of the same global mesh."""
+    a, b = _run_workers(mode="pp_lm")
+    assert a["devices"] == b["devices"] == 8
+    for section in ("eval0", "train1"):
+        assert np.isclose(a[section]["loss"], b[section]["loss"],
+                          rtol=1e-6)
+        assert a[section]["count"] == b[section]["count"]
+
+    from tpunet.train.loop import Trainer
+    from _mp_worker import pp_lm_case
+    cfg, ds = pp_lm_case()
+    t = Trainer(cfg, dataset=ds)
+    try:
+        e = t.evaluate()
+        assert e["count"] == a["eval0"]["count"]
+        assert np.isclose(e["loss"], a["eval0"]["loss"], rtol=1e-4)
+        m = t.train_one_epoch(0)
+        assert np.isclose(m["loss"], a["train1"]["loss"], rtol=2e-2)
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
 def test_two_process_checkpoint_roundtrip(tmp_path):
     """Multi-host orbax checkpointing under TRUE multi-controller, on
     the FSDP case (params + Adam moments sharded over the cross-process
