@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Tier-2 micro-benchmark: the default-config observability path must
+be within noise of a fully disabled one.
+
+The obs design promise (tpunet/obs/__init__.py) is that the default
+path adds no device syncs and only host-side ``perf_counter`` laps per
+step; this drives the same tiny-LM step loop both ways and fails if
+the instrumented loop is measurably slower. Standalone (not collected
+by pytest) so tier-1 wall time is unaffected:
+
+    JAX_PLATFORMS=cpu python scripts/check_obs_overhead.py
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# Generous threshold: CPU step times here are a few ms, where scheduler
+# jitter dominates; a real regression (a per-step device sync or record
+# write) shows up as 2x+, not 20%.
+MAX_RATIO = 1.20
+EPOCHS_MEASURED = 5
+
+
+def build_trainer(obs_enabled: bool, workdir: str):
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, ObsConfig, OptimConfig,
+                               TrainConfig)
+    from tpunet.train.loop import Trainer
+
+    cfg = TrainConfig(
+        epochs=EPOCHS_MEASURED + 1,
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        synthetic_train_size=256, synthetic_test_size=16,
+                        seq_len=64, vocab_size=32, native_loader=False),
+        model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                          vit_heads=4, dropout_rate=0.0, dtype="float32",
+                          vocab_size=32, max_seq_len=64),
+        optim=OptimConfig(learning_rate=1e-3),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(directory=workdir, save_best=False,
+                                    save_last=False),
+        obs=ObsConfig(enabled=obs_enabled),
+    )
+    return Trainer(cfg)
+
+
+def time_epochs(trainer) -> list:
+    # Epoch 1 compiles; measure the rest.
+    trainer.train_one_epoch(1)
+    times = []
+    for epoch in range(2, 2 + EPOCHS_MEASURED):
+        t0 = time.perf_counter()
+        trainer.train_one_epoch(epoch)
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def main() -> int:
+    results = {}
+    for label, enabled in (("disabled", False), ("default", True)):
+        with tempfile.TemporaryDirectory() as d:
+            trainer = build_trainer(enabled, d)
+            try:
+                results[label] = time_epochs(trainer)
+            finally:
+                trainer.close()
+    off = statistics.median(results["disabled"])
+    on = statistics.median(results["default"])
+    ratio = on / off if off > 0 else float("inf")
+    print(f"epoch median: obs-disabled {off * 1e3:.1f}ms, "
+          f"obs-default {on * 1e3:.1f}ms, ratio {ratio:.3f} "
+          f"(threshold {MAX_RATIO})")
+    if ratio > MAX_RATIO:
+        print("FAIL: default observability path exceeds the overhead "
+              "budget", file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Summarize a run's ``metrics.jsonl`` (tpunet/obs/ record schema).
+
+Usage:
+    python scripts/obs_report.py checkpoints/metrics.jsonl
+    python scripts/obs_report.py checkpoints/          # finds metrics.jsonl
+
+Prints the per-epoch training table, the step-time percentile /
+input-stall summary from the ``obs_epoch`` records, and device-memory
+high-water marks. Tolerates a truncated trailing line (a crashed or
+preempted run's artifact) via ``MetricsLogger.read_records``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _fmt_s(v, digits=4):
+    return "-" if v is None else f"{v:.{digits}f}"
+
+
+def _fmt_ms(v):
+    return "-" if v is None else f"{v * 1e3:.1f}"
+
+
+def report(records: list) -> list:
+    """Build the report lines from parsed metrics.jsonl records."""
+    epochs = [r for r in records if "kind" not in r and "epoch" in r]
+    obs = [r for r in records if r.get("kind") == "obs_epoch"]
+    steps = [r for r in records if r.get("kind") == "obs_step"]
+    lines = []
+
+    if epochs:
+        lines.append("== epochs ==")
+        lines.append(f"{'ep':>4} {'secs':>8} {'train_loss':>10} "
+                     f"{'train_acc':>9} {'test_loss':>9} {'test_acc':>8} "
+                     f"{'thruput':>10}")
+        for r in epochs:
+            thr = r.get("examples_per_sec", r.get("tokens_per_sec"))
+            lines.append(
+                f"{r['epoch']:>4} {_fmt_s(r.get('seconds'), 2):>8} "
+                f"{_fmt_s(r.get('train_loss')):>10} "
+                f"{_fmt_s(r.get('train_accuracy')):>9} "
+                f"{_fmt_s(r.get('test_loss')):>9} "
+                f"{_fmt_s(r.get('test_accuracy')):>8} "
+                f"{_fmt_s(thr, 1):>10}"
+                + ("  [partial]" if r.get("partial") else ""))
+
+    if obs:
+        lines.append("")
+        lines.append("== step time / stalls (obs_epoch) ==")
+        lines.append(f"{'ep':>4} {'steps':>6} {'p50ms':>8} {'p90ms':>8} "
+                     f"{'p99ms':>8} {'stall_s':>8} {'stall%':>7} "
+                     f"{'mfu':>6} {'procs':>6}")
+        for r in obs:
+            mfu = r.get("mfu")
+            lines.append(
+                f"{r['epoch']:>4} {r.get('steps', 0):>6} "
+                f"{_fmt_ms(r.get('step_time_p50_s')):>8} "
+                f"{_fmt_ms(r.get('step_time_p90_s')):>8} "
+                f"{_fmt_ms(r.get('step_time_p99_s')):>8} "
+                f"{_fmt_s(r.get('input_stall_s'), 2):>8} "
+                f"{100 * r.get('stall_frac', 0.0):>6.1f}% "
+                f"{_fmt_s(mfu, 3):>6} "
+                f"{r.get('live_processes', 1):>6}")
+        total_stall = sum(r.get("input_stall_s", 0.0) for r in obs)
+        total_train = sum(r.get("train_seconds", 0.0) for r in obs)
+        frac = total_stall / total_train if total_train else 0.0
+        lines.append(f"run input-stall: {total_stall:.2f}s of "
+                     f"{total_train:.2f}s train time ({100 * frac:.1f}%)")
+        peaks = [m.get("peak_bytes_in_use")
+                 for r in obs for m in r.get("device_memory", [])
+                 if m.get("peak_bytes_in_use") is not None]
+        if peaks:
+            lines.append(f"device memory high-water: "
+                         f"{max(peaks) / 2**30:.2f} GiB")
+        else:
+            lines.append("device memory: backend reports no allocator "
+                         "stats (CPU)")
+
+    if steps:
+        lines.append("")
+        times = sorted(r["step_time_s"] for r in steps
+                       if "step_time_s" in r)
+        mid = times[len(times) // 2]
+        lines.append(f"== obs_step samples: {len(steps)} "
+                     f"(median {mid * 1e3:.1f}ms) ==")
+
+    if not lines:
+        lines.append("no records found")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="metrics.jsonl, or a directory "
+                                 "containing one (e.g. checkpoints/)")
+    args = ap.parse_args(argv)
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    if not os.path.isfile(path):
+        print(f"no metrics.jsonl at {path}", file=sys.stderr)
+        return 1
+    from tpunet.utils.logging import MetricsLogger
+    for line in report(MetricsLogger.read_records(path)):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -549,8 +549,15 @@ class TestFlashAttention:
                                        block_q=32, block_k=32,
                                        interpret=True))
         out = fn(qs, ks, vs)
-        # (PartitionSpec trims trailing Nones)
-        assert out.sharding.spec == P("data", None, "model")
+        # Normalize: newer jax trims trailing Nones in PartitionSpec,
+        # older jax keeps them — same sharding either way.
+        def _trim(spec):
+            parts = list(spec)
+            while parts and parts[-1] is None:
+                parts.pop()
+            return tuple(parts)
+
+        assert _trim(out.sharding.spec) == ("data", None, "model")
         ref = dense_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)

@@ -54,10 +54,16 @@ def test_cutmix_pixels_come_from_two_sources():
     # every output pixel equals the corresponding pixel of x or of the
     # SAME paired row; the fraction equal to x matches lam
     same = np.isclose(o, xs).all(-1)              # [B, H, W]
-    frac = same.mean()
-    assert abs(frac - lam) < 0.05  # box-quantization slack
+    # rows the permutation mapped to themselves are unchanged even
+    # inside the box (x_b == x there) — drop them before comparing
+    # against lam; which rows those are depends on the jax version's
+    # PRNG stream, so the test must not bake in a count
+    fixed = same.all(axis=(1, 2))
+    assert not fixed.all()   # key 2 must cut at least one real pair
+    frac = same[~fixed].mean()
+    assert abs(frac - lam) < 0.05  # isclose-coincidence slack
     # and the box is contiguous: per row, the non-same region is a box
-    b0 = ~same[0]
+    b0 = ~same[~fixed][0]
     if b0.any():
         rows = np.where(b0.any(1))[0]
         cols = np.where(b0.any(0))[0]

@@ -59,7 +59,12 @@ def test_preempted_run_saves_state_and_resumes(tmp_path):
     # ... but metrics.jsonl self-describes the interruption: a
     # partial: true row (no eval fields — the eval pass was skipped).
     with open(os.path.join(str(tmp_path), "metrics.jsonl")) as f:
-        rows = [json.loads(line) for line in f]
+        all_rows = [json.loads(line) for line in f]
+    # obs_* rows (tpunet/obs/) share the file; the training rows are
+    # the kind-less ones.
+    rows = [r for r in all_rows if "kind" not in r]
+    obs_rows = [r for r in all_rows if r.get("kind") == "obs_epoch"]
+    assert len(obs_rows) == 1 and obs_rows[0].get("partial") is True
     assert len(rows) == 1 and rows[0]["partial"] is True
     assert rows[0]["epoch"] == 1 and rows[0]["step"] == 2
     assert "test_accuracy" not in rows[0]
@@ -89,7 +94,11 @@ def test_metrics_jsonl_written(tmp_path):
         trainer.close()
     path = os.path.join(str(tmp_path), "metrics.jsonl")
     with open(path) as f:
-        records = [json.loads(line) for line in f]
+        all_records = [json.loads(line) for line in f]
+    records = [r for r in all_records if "kind" not in r]
     assert [r["epoch"] for r in records] == [1, 2]
     for r in records:
         assert {"seconds", "step", "train_loss", "test_accuracy"} <= set(r)
+    # the obs subsystem interleaves its per-epoch summaries
+    obs = [r for r in all_records if r.get("kind") == "obs_epoch"]
+    assert [r["epoch"] for r in obs] == [1, 2]

@@ -25,3 +25,4 @@ app and cluster launchers) as an idiomatic JAX/XLA framework:
 """
 
 __version__ = "0.1.0"
+

@@ -55,13 +55,24 @@ def _snapshot(tree):
 
 
 class Checkpointer:
-    def __init__(self, cfg: CheckpointConfig):
+    def __init__(self, cfg: CheckpointConfig, obs=None):
         self.cfg = cfg
         self.directory = os.path.abspath(os.path.expanduser(cfg.directory))
         self._mgr: Optional[ocp.CheckpointManager] = None
         self._best = ocp.StandardCheckpointer()
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pending = []
+        # Optional Observability (tpunet/obs/): labels save dispatch
+        # and durability waits as xprof spans and accounts their host
+        # cost (ckpt_saves / ckpt_wait_s) — the "is the step loop
+        # stalling on checkpoints?" half of the stall split.
+        self._obs = obs
+
+    def _span(self, name: str):
+        if self._obs is not None:
+            return self._obs.span(name)
+        from contextlib import nullcontext
+        return nullcontext()
 
     @property
     def manager(self) -> ocp.CheckpointManager:
@@ -95,8 +106,18 @@ class Checkpointer:
             else:
                 still.append(f)
         self._pending = still
-        while len(self._pending) > 1:
-            self._pending.pop(0).result()
+        if len(self._pending) > 1:
+            # THIS join is where the step loop actually stalls on
+            # checkpoints mid-run (wait() only runs at end-of-run,
+            # after the last obs record) — so it is the accumulation
+            # point that makes ckpt_wait_s a live number.
+            import time
+            t0 = time.perf_counter()
+            while len(self._pending) > 1:
+                self._pending.pop(0).result()
+            if self._obs is not None:
+                self._obs.registry.counter("ckpt_wait_s").inc(
+                    time.perf_counter() - t0)
         self._pending.append(self._pool.submit(fn))
 
     def _drain(self) -> None:
@@ -118,7 +139,10 @@ class Checkpointer:
     def save_state(self, step: int, payload: Dict[str, Any]) -> None:
         if not self.cfg.save_last:
             return
-        snap = _snapshot(payload)
+        with self._span("tpunet/ckpt_dispatch"):
+            snap = _snapshot(payload)
+        if self._obs is not None:
+            self._obs.registry.counter("ckpt_saves").inc()
         # The manager is created INSIDE the worker lambda on purpose:
         # CheckpointManager.__init__ runs a cross-host barrier
         # (sync_global_processes), so on multi-host it must stay
@@ -188,7 +212,10 @@ class Checkpointer:
                   meta: Optional[Dict[str, Any]] = None) -> None:
         if not self.cfg.save_best:
             return
-        snap = _snapshot(payload)
+        with self._span("tpunet/ckpt_dispatch"):
+            snap = _snapshot(payload)
+        if self._obs is not None:
+            self._obs.registry.counter("ckpt_saves").inc()
         path = os.path.join(self.directory, "best")
         meta_path = os.path.join(self.directory, "best_meta.json")
 
@@ -258,10 +285,16 @@ class Checkpointer:
 
     def wait(self) -> None:
         """Block until async writes are durable (end of run)."""
-        self._drain()
-        if self._mgr is not None:
-            self._mgr.wait_until_finished()
-        self._best.wait_until_finished()
+        import time
+        t0 = time.perf_counter()
+        with self._span("tpunet/ckpt_wait"):
+            self._drain()
+            if self._mgr is not None:
+                self._mgr.wait_until_finished()
+            self._best.wait_until_finished()
+        if self._obs is not None:
+            self._obs.registry.counter("ckpt_wait_s").inc(
+                time.perf_counter() - t0)
 
     def close(self) -> None:
         self.wait()

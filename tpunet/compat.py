@@ -1,0 +1,54 @@
+"""jax version-compatibility layer.
+
+The codebase targets the modern jax surface; these wrappers let the
+same call sites run on older installs. Import from here instead of
+reaching into jax version-conditionally at each site — and never patch
+the jax namespace itself (other libraries in the process probe
+``hasattr(jax, ...)`` and must see the real jax).
+
+- ``shard_map``: ``jax.shard_map`` (with ``check_vma``) on new jax;
+  on older installs, ``jax.experimental.shard_map.shard_map`` with
+  ``check_vma`` translated to its old spelling ``check_rep``.
+- ``def_partition_compat``: ``custom_partitioning.def_partition``
+  minus the Shardy keywords (``sharding_rule``,
+  ``need_replication_factors``) on pre-Shardy jax, where the GSPMD
+  callbacks carry the full partitioning behavior — passing them there
+  raises TypeError at import time.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    _old_params = set(inspect.signature(_shard_map_old).parameters)
+
+    @functools.wraps(_shard_map_old)
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs and "check_vma" not in _old_params:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_old(f, *args, **kwargs)
+
+
+def _supported_kwargs(fn) -> set:
+    sig = inspect.signature(fn)
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD
+           for p in sig.parameters.values()):
+        return set()  # sentinel: accepts anything
+    return set(sig.parameters)
+
+
+def def_partition_compat(partitioned, **kwargs) -> None:
+    """``partitioned.def_partition(**kwargs)`` minus any keyword the
+    installed jax does not know (Shardy args on pre-Shardy jax)."""
+    supported = _supported_kwargs(partitioned.def_partition)
+    if supported:
+        kwargs = {k: v for k, v in kwargs.items() if k in supported}
+    partitioned.def_partition(**kwargs)

@@ -250,6 +250,31 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Step-level observability (tpunet/obs/): per-step timing
+    histograms, throughput/MFU and input-stall accounting, epoch-
+    boundary device-memory gauges and multi-host heartbeat, all
+    emitted as ``obs_epoch`` records into ``metrics.jsonl``.
+
+    The default path is deliberately sync-free: every number is a
+    host-side ``perf_counter`` lap or an epoch-boundary runtime query,
+    so enabling it adds no device round-trips to the step loop."""
+
+    enabled: bool = True
+    # Emit an ``obs_step`` record every N steps (0 = per-epoch records
+    # only). Host-side values only — no device sync either way.
+    step_records_every: int = 0
+    # Windowed profiling: capture a jax profiler trace for exactly
+    # [profile_start_step, profile_start_step + profile_num_steps).
+    # num_steps == 0 traces from start_step to the end of the run
+    # (with both at 0 and --profile-dir set: the old whole-run trace);
+    # either knob without --profile-dir writes under
+    # <checkpoint-dir>/profile.
+    profile_start_step: int = 0
+    profile_num_steps: int = 0
+
+
+@dataclass(frozen=True)
 class CheckpointConfig:
     directory: str = "checkpoints"
     save_best: bool = True            # reference best-by-test-acc (:238-240)
@@ -274,6 +299,7 @@ class TrainConfig:
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -450,7 +476,26 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-model", type=int, default=None,
                    help="tensor-parallel axis size")
     p.add_argument("--dtype", default=None, choices=["bfloat16", "float32"])
-    p.add_argument("--profile-dir", default=None)
+    p.add_argument("--profile-dir", default=None,
+                   help="jax profiler trace output directory; combine "
+                        "with --profile-start-step/--profile-num-steps "
+                        "to capture a step window instead of the run")
+    p.add_argument("--profile-start-step", type=int, default=None,
+                   help="global step at which the profiler trace "
+                        "starts (alone: traces to the end of the run, "
+                        "under <checkpoint-dir>/profile unless "
+                        "--profile-dir is set)")
+    p.add_argument("--profile-num-steps", type=int, default=None,
+                   help="steps to trace from --profile-start-step "
+                        "(0 = until the end of the run); without "
+                        "--profile-dir the trace lands under "
+                        "<checkpoint-dir>/profile")
+    p.add_argument("--no-obs", action="store_true",
+                   help="disable the observability subsystem (no "
+                        "obs_* records, spans, or step timing)")
+    p.add_argument("--obs-step-every", type=int, default=None,
+                   help="emit a per-step obs_step record every N "
+                        "steps (0 = per-epoch obs records only)")
     p.add_argument("--log-every-steps", type=int, default=None,
                    help="emit a step/loss/lr line every N steps (0 = "
                         "per-epoch only, like the reference)")
@@ -474,6 +519,17 @@ def config_from_args(argv=None) -> TrainConfig:
     args = build_argparser().parse_args(argv)
     cfg = preset(args.preset)
     data, model, optim, mesh, ckpt = cfg.data, cfg.model, cfg.optim, cfg.mesh, cfg.checkpoint
+    obs = cfg.obs
+    if args.no_obs:
+        obs = dataclasses.replace(obs, enabled=False)
+    if args.obs_step_every is not None:
+        obs = dataclasses.replace(obs, step_records_every=args.obs_step_every)
+    if args.profile_start_step is not None:
+        obs = dataclasses.replace(obs,
+                                  profile_start_step=args.profile_start_step)
+    if args.profile_num_steps is not None:
+        obs = dataclasses.replace(obs,
+                                  profile_num_steps=args.profile_num_steps)
     if args.batch_size is not None:
         data = dataclasses.replace(data, batch_size=args.batch_size)
     if args.image_size is not None:
@@ -574,7 +630,8 @@ def config_from_args(argv=None) -> TrainConfig:
         ckpt = dataclasses.replace(ckpt, directory=args.checkpoint_dir)
     if args.resume:
         ckpt = dataclasses.replace(ckpt, resume=True)
-    cfg = cfg.replace(data=data, model=model, optim=optim, mesh=mesh, checkpoint=ckpt)
+    cfg = cfg.replace(data=data, model=model, optim=optim, mesh=mesh,
+                      checkpoint=ckpt, obs=obs)
     if args.epochs is not None:
         cfg = cfg.replace(epochs=args.epochs)
     if args.seed is not None:

@@ -1,3 +1,4 @@
 from tpunet.data.cifar10 import get_dataset, load_cifar10, synthetic_cifar10  # noqa: F401
 from tpunet.data.augment import make_train_augment, make_eval_preprocess  # noqa: F401
-from tpunet.data.pipeline import train_batches, eval_batches, steps_per_epoch  # noqa: F401
+from tpunet.data.pipeline import (train_batches, eval_batches,  # noqa: F401
+                                  steps_per_epoch, timed_batches)

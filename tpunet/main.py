@@ -28,8 +28,10 @@ from tpunet.utils import log0
 def main(argv=None) -> int:
     initialize_distributed()
     cfg = config_from_args(argv)
-    if cfg.profile_dir:
-        jax.profiler.start_trace(cfg.profile_dir)
+    # Profiling is owned by the obs subsystem now (tpunet/obs/spans.py
+    # WindowedProfiler): --profile-dir alone still traces the whole
+    # run, but the trace starts/stops at step boundaries inside the
+    # trainer so --profile-start-step/--profile-num-steps can scope it.
 
     n_proc = jax.process_count()
     if n_proc > 1:
@@ -57,14 +59,10 @@ def main(argv=None) -> int:
         else:
             trainer.train()
     finally:
-        # Runs on the NaN-guard/preemption-raise paths too; the nested
-        # finally makes each cleanup independent — a failing checkpoint
-        # flush in close() cannot skip the profiler flush or vice versa.
-        try:
-            trainer.close()
-        finally:
-            if cfg.profile_dir:
-                jax.profiler.stop_trace()
+        # Runs on the NaN-guard/preemption-raise paths too; close()
+        # flushes checkpoints AND any still-open profiler trace, each
+        # independently (Trainer.close's own try/finally).
+        trainer.close()
     return 0
 
 

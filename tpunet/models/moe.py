@@ -77,6 +77,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
+from tpunet.compat import shard_map
+
 
 def _route(probs, k: int, e: int, cap: int):
     """Top-k capacity-bounded routing: ``probs`` [n, e] float32 ->
@@ -396,7 +398,7 @@ class MoeMlp(nn.Module):
             return y.reshape(bl, tl, dd), aux
 
         tok_spec = P("data", "seq", None)
-        fn = jax.shard_map(
+        fn = shard_map(
             body, mesh=self.mesh,
             in_specs=(tok_spec, tok_spec, P("model", None, None),
                       P("model", None), P("model", None, None),

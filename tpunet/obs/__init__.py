@@ -1,0 +1,197 @@
+"""Step-level observability subsystem.
+
+One ``Observability`` object per run orchestrates the pieces:
+
+- ``registry``   — counters / gauges / histograms (p50/p90/p99) with
+  pluggable sinks: the run's ``metrics.jsonl`` (``JsonlSink``) and an
+  in-memory sink for tests (``MemorySink``).
+- ``spans``      — ``jax.profiler.TraceAnnotation`` context managers
+  labeling step / data-wait / eval / checkpoint phases in xprof, plus
+  ``WindowedProfiler`` (trace exactly steps
+  ``[profile_start_step, profile_start_step + profile_num_steps)``).
+- ``perf``       — analytic model FLOPs -> MFU, device peak lookup.
+- ``memory``     — per-device ``memory_stats()`` gauges and the
+  coordinator-side multi-host heartbeat, sampled at epoch boundaries.
+
+Clock discipline: all timing is ``time.perf_counter`` (monotonic);
+jax dispatch is async, so per-step wall time is the host-side lap
+around the dispatch call — once the dispatch queue saturates, laps
+converge to true device step time — and ``block_until_ready`` fences
+run at *window edges only* (profile window start/stop), never on
+interior steps. Cost model: the default config (enabled, no per-step
+records, no profiling) adds host-side spans and perf_counter laps per
+step but NO device syncs and no record formatting; ``--no-obs``
+reduces the step loop to a single predicate branch (though a
+configured profile window still instruments, since tracing needs the
+step hooks).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from tpunet.obs import memory as obs_memory
+from tpunet.obs import perf
+from tpunet.obs.registry import (Counter, Gauge, Histogram, JsonlSink,
+                                 MemorySink, Registry)
+from tpunet.obs.spans import NULL_SPAN, WindowedProfiler, span, step_span
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "JsonlSink", "MemorySink",
+    "NULL_SPAN", "Observability", "Registry", "WindowedProfiler",
+    "perf", "span", "step_span",
+]
+
+
+class Observability:
+    """Run-scoped observability facade the trainer threads through.
+
+    ``enabled`` gates all accounting and record emission;
+    ``hot`` additionally covers a live profile window, so the loop
+    instruments steps whenever either wants them. Everything here is
+    host-side; the only device syncs this class ever issues are the
+    profile-window edge fences (via the ``sync`` callable the loop
+    provides).
+    """
+
+    def __init__(self, cfg, *, profile_dir: str = "",
+                 checkpoint_dir: str = "", unit: str = "examples"):
+        if cfg.step_records_every < 0:
+            raise ValueError(f"obs.step_records_every must be >= 0, "
+                             f"got {cfg.step_records_every}")
+        self.enabled = bool(cfg.enabled)
+        self.unit = unit
+        self.step_records_every = cfg.step_records_every
+        self.registry = Registry()
+        if ((cfg.profile_num_steps or cfg.profile_start_step)
+                and not profile_dir):
+            # A window knob without --profile-dir lands next to the
+            # checkpoints rather than silently doing nothing: the knob
+            # people reach for mid-incident should not demand a second
+            # knob. (--profile-start-step alone traces from that step
+            # to the end of the run.)
+            profile_dir = os.path.join(checkpoint_dir or ".", "profile")
+        self.profiler = WindowedProfiler(
+            profile_dir, cfg.profile_start_step, cfg.profile_num_steps)
+        self._run_start = time.perf_counter()
+        self._flops_per_unit = 0.0
+        self._last_wait = 0.0
+
+    # -- setup ----------------------------------------------------------
+
+    @property
+    def hot(self) -> bool:
+        """True when the step loop should instrument (accounting on,
+        or a profile window still pending/open). The loop hoists this
+        to a local per epoch, so the disabled path pays one branch per
+        step."""
+        return self.enabled or self.profiler.active
+
+    def add_sink(self, sink) -> None:
+        self.registry.add_sink(sink)
+
+    def set_flops_per_unit(self, flops: float) -> None:
+        self._flops_per_unit = float(flops)
+
+    # -- spans ----------------------------------------------------------
+
+    def span(self, name: str):
+        return span(name) if self.hot else NULL_SPAN
+
+    def step_span(self, step: int):
+        return step_span(step) if self.hot else NULL_SPAN
+
+    # -- per-step hooks (called only when ``hot``) ----------------------
+
+    def before_step(self, step: int, sync=None) -> None:
+        """Profile-window edge check; ``sync`` (block_until_ready over
+        the live state) runs only when a window opens or closes at
+        this step."""
+        if self.profiler.active:
+            self.profiler.on_step(step, sync)
+
+    def observe_step(self, step: int, seconds: float) -> None:
+        """One finished step's host lap (dispatch-side wall time)."""
+        if not self.enabled:
+            return
+        self.registry.histogram("step_time_s").observe(seconds)
+        every = self.step_records_every
+        if every and step % every == 0:
+            self.registry.emit("obs_step", {
+                "step": step,
+                "step_time_s": round(seconds, 6),
+                "data_wait_s": round(self._last_wait, 6),
+            })
+
+    def observe_data_wait(self, seconds: float) -> None:
+        """Host time spent blocked on the input pipeline for one batch
+        (the stall side of the stall-vs-compute split). The epoch's
+        stall total is the data_wait_s histogram's window sum."""
+        if not self.enabled:
+            return
+        self._last_wait = seconds
+        self.registry.histogram("data_wait_s").observe(seconds)
+
+    # -- epoch window ----------------------------------------------------
+
+    def begin_epoch(self, epoch: int) -> None:
+        if not self.enabled:
+            return
+        self.registry.reset_window()
+
+    def end_epoch(self, *, epoch: int, step: int, units: float,
+                  train_seconds: float, eval_seconds: float = 0.0,
+                  partial: bool = False) -> Optional[dict]:
+        """Close the epoch window: percentiles, throughput, stall
+        fraction, MFU, memory gauges, heartbeat — one ``obs_epoch``
+        record to every sink. Returns the record (None when
+        disabled)."""
+        if not self.enabled:
+            return None
+        reg = self.registry
+        steps = reg.histogram("step_time_s").summary()
+        step_total = reg.histogram("step_time_s").total
+        wait_total = reg.histogram("data_wait_s").total
+        busy = step_total + wait_total
+        throughput = units / train_seconds if train_seconds > 0 else 0.0
+        mem = obs_memory.sample_memory_gauges(reg)
+        live = obs_memory.heartbeat(
+            reg, time.perf_counter() - self._run_start)
+        record = {
+            "epoch": epoch,
+            "step": step,
+            "train_seconds": round(train_seconds, 4),
+            "eval_seconds": round(eval_seconds, 4),
+            "unit": self.unit,
+            f"{self.unit}_per_sec": round(throughput, 2),
+            "steps": int(steps.get("count", 0)),
+            "step_time_mean_s": steps.get("mean"),
+            "step_time_p50_s": steps.get("p50"),
+            "step_time_p90_s": steps.get("p90"),
+            "step_time_p99_s": steps.get("p99"),
+            "input_stall_s": round(wait_total, 4),
+            "stall_frac": round(wait_total / busy, 4) if busy > 0 else 0.0,
+            "device_memory": mem,
+            "live_processes": live,
+        }
+        util = perf.mfu(throughput, self._flops_per_unit)
+        if util is not None:
+            record["mfu"] = round(util, 4)
+        ckpt_saves = reg.counter("ckpt_saves").value
+        if ckpt_saves:
+            record["ckpt_saves"] = int(ckpt_saves)
+            record["ckpt_wait_s"] = round(
+                reg.counter("ckpt_wait_s").value, 4)
+        if partial:
+            record["partial"] = True
+        reg.emit("obs_epoch", record)
+        return record
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self, sync=None) -> None:
+        """Flush a still-open profile window (end of run / error
+        path)."""
+        self.profiler.close(sync)

@@ -1,0 +1,71 @@
+"""Device memory gauges and the multi-host heartbeat.
+
+Both are *epoch-boundary* samplers: ``memory_stats()`` is a host-side
+runtime query (no device sync) but still costs a Python round-trip per
+device, and the heartbeat is a real cross-host collective — neither
+belongs on the per-step path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+
+# memory_stats() keys worth persisting (PJRT exposes many more; these
+# are the capacity-planning ones and are stable across TPU runtimes).
+_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_records() -> List[Dict]:
+    """Per-local-device memory samples. Backends without allocator
+    stats (CPU's PJRT returns None) yield an entry with just the
+    device id, so the record schema is shape-stable across backends."""
+    out = []
+    for d in jax.local_devices():
+        rec: Dict = {"device": d.id}
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        if stats:
+            for k in _MEM_KEYS:
+                if k in stats:
+                    rec[k] = int(stats[k])
+        out.append(rec)
+    return out
+
+
+def sample_memory_gauges(registry) -> List[Dict]:
+    """Set ``mem_bytes_in_use`` / ``mem_peak_bytes_in_use`` gauges
+    (max over local devices — the OOM-relevant figure) and return the
+    per-device records for the epoch summary."""
+    records = device_memory_records()
+    in_use = [r["bytes_in_use"] for r in records if "bytes_in_use" in r]
+    peak = [r["peak_bytes_in_use"] for r in records
+            if "peak_bytes_in_use" in r]
+    if in_use:
+        registry.gauge("mem_bytes_in_use").set(max(in_use))
+    if peak:
+        registry.gauge("mem_peak_bytes_in_use").set(max(peak))
+    return records
+
+
+def heartbeat(registry, elapsed_s: float) -> int:
+    """Coordinator-side liveness gauge: every process contributes a
+    flag to an allgather (so a wedged host surfaces as a hang HERE, at
+    a labeled epoch boundary, rather than deep inside a step's
+    collective); the coordinator records how many answered and when.
+    Single-process runs skip the collective."""
+    n = jax.process_count()
+    if n > 1:
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            jnp.ones((), jnp.int32))
+        n = int(np.asarray(flags).sum())
+    registry.gauge("live_processes").set(n)
+    registry.gauge("heartbeat_s").set(elapsed_s)
+    return n

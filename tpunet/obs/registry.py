@@ -1,0 +1,176 @@
+"""Metrics registry: counters, gauges, histograms with pluggable sinks.
+
+The instruments are deliberately host-side-only (plain Python floats):
+observing a value never touches a device or forces a sync — the caller
+decides when device values become host floats. Sinks receive finished
+*records* (flat JSON-able dicts tagged with a ``kind``), not raw
+observations, so the per-step hot path never formats or writes
+anything; records are built at window edges (epoch boundaries, opt-in
+per-step sampling).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+
+class Counter:
+    """Monotonically increasing sum (e.g. checkpoint saves, stall
+    seconds)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class Gauge:
+    """Last-write-wins sample (e.g. device bytes in use)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+class Histogram:
+    """Windowed distribution with exact percentiles.
+
+    Observations accumulate in a list until ``reset()`` (one window ==
+    one epoch in the trainer); percentiles sort a copy on demand, so
+    ``observe`` is a single append — cheap enough for the per-step
+    path.
+    """
+
+    __slots__ = ("values",)
+
+    def __init__(self):
+        self.values: List[float] = []
+
+    def observe(self, value: float) -> None:
+        self.values.append(float(value))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def total(self) -> float:
+        return math.fsum(self.values)
+
+    @staticmethod
+    def _interp(xs: List[float], q: float) -> float:
+        """q-th percentile of an already-sorted non-empty list."""
+        if len(xs) == 1:
+            return xs[0]
+        rank = (q / 100.0) * (len(xs) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(xs) - 1)
+        frac = rank - lo
+        return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Linear-interpolated q-th percentile (q in [0, 100]); None on
+        an empty window."""
+        if not self.values:
+            return None
+        return self._interp(sorted(self.values), q)
+
+    def summary(self) -> Dict[str, float]:
+        """{count, mean, p50, p90, p99} of the current window (empty
+        dict on an empty window); one sort serves all three
+        percentiles."""
+        if not self.values:
+            return {}
+        xs = sorted(self.values)
+        return {
+            "count": len(xs),
+            "mean": math.fsum(xs) / len(xs),
+            "p50": self._interp(xs, 50),
+            "p90": self._interp(xs, 90),
+            "p99": self._interp(xs, 99),
+        }
+
+    def reset(self) -> None:
+        self.values = []
+
+
+class MemorySink:
+    """In-memory sink for tests: records land in ``self.records``."""
+
+    def __init__(self):
+        self.records: List[dict] = []
+
+    def write(self, record: dict) -> None:
+        self.records.append(record)
+
+    def by_kind(self, kind: str) -> List[dict]:
+        return [r for r in self.records if r.get("kind") == kind]
+
+
+class JsonlSink:
+    """Sink adapter over ``MetricsLogger`` — obs records share the
+    run's ``metrics.jsonl`` (one append-mode file, coordinator-only
+    writes; MetricsLogger already enforces both)."""
+
+    def __init__(self, logger):
+        self._logger = logger
+
+    def write(self, record: dict) -> None:
+        self._logger.log(record)
+
+
+class Registry:
+    """Named instruments + sinks. ``counter``/``gauge``/``histogram``
+    are get-or-create, so call sites never coordinate registration."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._sinks: list = []
+
+    def counter(self, name: str) -> Counter:
+        return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str) -> Histogram:
+        return self._histograms.setdefault(name, Histogram())
+
+    def add_sink(self, sink) -> None:
+        self._sinks.append(sink)
+
+    def emit(self, kind: str, record: dict) -> None:
+        """Tag and fan a finished record out to every sink."""
+        rec = {"kind": kind}
+        rec.update(record)
+        for sink in self._sinks:
+            sink.write(rec)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat {name: value} view of every instrument: counters and
+        gauges by name, histograms as ``name_p50`` etc."""
+        out: Dict[str, float] = {}
+        for name, c in self._counters.items():
+            out[name] = c.value
+        for name, g in self._gauges.items():
+            if g.value is not None:
+                out[name] = g.value
+        for name, h in self._histograms.items():
+            for k, v in h.summary().items():
+                out[f"{name}_{k}"] = v
+        return out
+
+    def reset_window(self) -> None:
+        """Start a new observation window: histograms clear; counters
+        and gauges persist (they are run-cumulative)."""
+        for h in self._histograms.values():
+            h.reset()

@@ -41,6 +41,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpunet.compat import shard_map
+
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/grads NaN-free
 
 
@@ -452,7 +454,7 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                               local_divisor=mesh.shape[seq_axis])
     spec = P(batch_axis, seq_axis, h_ax, None)
     if segment_ids is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(ulysses_attention, axis_name=seq_axis,
                               causal=causal, scale=scale, core=core,
                               block=block),
@@ -467,7 +469,7 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                  block=block,
                                  segment_ids=(q_seg, kv_seg))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec, s_spec, s_spec),
         out_specs=spec, check_vma=False)
     return fn(q, k, v, *segment_ids)
@@ -491,7 +493,7 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     h_ax = _resolve_head_axis(mesh, head_axis, q.shape[2])
     spec = P(batch_axis, seq_axis, h_ax, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis,
                           causal=causal, scale=scale, core=core),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

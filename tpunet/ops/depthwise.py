@@ -133,6 +133,8 @@ def _pallas_forward(x: jax.Array, w: jax.Array, stride: int,
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tpunet.compat import def_partition_compat
+
 
 def _shard_specs(arg_shapes):
     def spec_of(s):
@@ -161,7 +163,8 @@ def _partition(stride, interpret, mesh, arg_shapes, result_shape):
 
 
 _partitioned = custom_partitioning(_pallas_forward, static_argnums=(2, 3))
-_partitioned.def_partition(
+def_partition_compat(
+    _partitioned,
     partition=_partition,
     infer_sharding_from_operands=_infer,
     sharding_rule="n h w c, kh kw c -> n ho wo c",

@@ -572,6 +572,8 @@ def _pallas_backward(q, k, v, out, lse, do,
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tpunet.compat import def_partition_compat
+
 
 def _q_spec_of(arg_shapes) -> P:
     sh = arg_shapes[0].sharding
@@ -642,7 +644,8 @@ _STATIC = dict(static_argnums=(3, 4, 5, 6, 7))
 _REPL = ("tq", "d", "tk")
 
 _partitioned = custom_partitioning(_pallas_forward, **_STATIC)
-_partitioned.def_partition(
+def_partition_compat(
+    _partitioned,
     partition=_partition_fwd,
     infer_sharding_from_operands=_infer_fwd,
     sharding_rule="b tq h d, b tk h d, b tk h d -> b tq h d",
@@ -650,7 +653,8 @@ _partitioned.def_partition(
 )
 
 _partitioned_res = custom_partitioning(_pallas_forward_res, **_STATIC)
-_partitioned_res.def_partition(
+def_partition_compat(
+    _partitioned_res,
     partition=_partition_res,
     infer_sharding_from_operands=_infer_res,
     sharding_rule="b tq h d, b tk h d, b tk h d -> b tq h d, b h tq",
@@ -667,7 +671,8 @@ def _pallas_backward_nog(q, k, v, out, lse, do, causal, scale, block_q,
 
 _partitioned_bwd = custom_partitioning(
     _pallas_backward_nog, static_argnums=(6, 7, 8, 9, 10))
-_partitioned_bwd.def_partition(
+def_partition_compat(
+    _partitioned_bwd,
     partition=_partition_bwd,
     infer_sharding_from_operands=_infer_bwd,
     sharding_rule=("b tq h d, b tk h d, b tk h d, b tq h d, b h tq, "
@@ -783,7 +788,8 @@ def _partition_bwd_seg(causal, scale, block_q, block_k, interpret, mesh,
 _SEG_STATIC = dict(static_argnums=(5, 6, 7, 8, 9))
 
 _partitioned_seg = custom_partitioning(_pallas_forward_seg, **_SEG_STATIC)
-_partitioned_seg.def_partition(
+def_partition_compat(
+    _partitioned_seg,
     partition=_partition_fwd_seg,
     infer_sharding_from_operands=_infer_fwd,
     sharding_rule=("b tq h d, b tk h d, b tk h d, b tq, b tk "
@@ -793,7 +799,8 @@ _partitioned_seg.def_partition(
 
 _partitioned_res_seg = custom_partitioning(_pallas_forward_res_seg,
                                            **_SEG_STATIC)
-_partitioned_res_seg.def_partition(
+def_partition_compat(
+    _partitioned_res_seg,
     partition=_partition_res_seg,
     infer_sharding_from_operands=_infer_res,
     sharding_rule=("b tq h d, b tk h d, b tk h d, b tq, b tk "
@@ -803,7 +810,8 @@ _partitioned_res_seg.def_partition(
 
 _partitioned_bwd_seg = custom_partitioning(
     _pallas_backward_seg, static_argnums=(8, 9, 10, 11, 12))
-_partitioned_bwd_seg.def_partition(
+def_partition_compat(
+    _partitioned_bwd_seg,
     partition=_partition_bwd_seg,
     infer_sharding_from_operands=_infer_bwd,
     sharding_rule=("b tq h d, b tk h d, b tk h d, b tq, b tk, "

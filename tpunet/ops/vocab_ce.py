@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tpunet.compat import shard_map
+
 
 def resolve_vocab_ce(vocab_ce: str, mesh, vocab_size: int) -> str:
     """Resolve a ``--vocab-ce`` setting: "auto" prefers "sharded"
@@ -135,7 +137,7 @@ def vocab_parallel_ce(h, emb, targets, mesh, *, smoothing: float = 0.0):
         return ce, hit
 
     tok = P("data", None)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P("data", None, None), P("model", None), tok),
         out_specs=(tok, tok), check_vma=False)

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpunet.compat import shard_map
+
 
 def gpipe(stage_apply: Callable, stacked_params, x, *,
           mesh: Mesh, n_micro: int, axis_name: str = "pipe",
@@ -109,7 +111,7 @@ def gpipe(stage_apply: Callable, stacked_params, x, *,
         args = ((stacked_params, x)
                 + ((extra,) if has_extra else ()) + (key,))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False)
     return fn(*args)
@@ -341,13 +343,13 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
         if keyed:
             body = functools.partial(_gpipe_body_keyed, stage_apply,
                                      **kw)
-            return jax.shard_map(
+            return shard_map(
                 body, mesh=mesh,
                 in_specs=(p_specs, x_spec) + e_in + (P(),),
                 out_specs=fwd_out_specs, check_vma=False)(
                     params, xx, *e_args, k)
         body = functools.partial(_gpipe_body, stage_apply, **kw)
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh, in_specs=(p_specs, x_spec) + e_in,
             out_specs=fwd_out_specs, check_vma=False)(
                 params, xx, *e_args)
@@ -358,7 +360,7 @@ def onef1b(stage_apply: Callable, stacked_params, x, *,
                                  uniform_bwd=uniform_bwd,
                                  ep_axis=ep_axis,
                                  param_specs=p_specs, **kw)
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(p_specs, x_spec, e_spec, P(), x_spec, P()),
             out_specs=(p_specs, x_spec), check_vma=False)(
@@ -939,7 +941,7 @@ def interleaved(stage_apply: Callable, stacked_params, x, *,
 
     def fwd_program(params, xx, exx, k):
         body = functools.partial(_ileave_fwd_body, stage_apply, **kw)
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh, in_specs=(p_specs, x_spec, e_spec, P()),
             out_specs=fwd_out_specs, check_vma=False)(params, xx, exx, k)
 
@@ -947,7 +949,7 @@ def interleaved(stage_apply: Callable, stacked_params, x, *,
         body = functools.partial(_ileave_bwd_body, stage_apply,
                                  sched=sched, param_specs=p_specs,
                                  **kw)
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(p_specs, x_spec, e_spec, P(), x_spec, P()),
             out_specs=(p_specs, x_spec), check_vma=False)(

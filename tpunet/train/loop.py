@@ -20,7 +20,9 @@ import numpy as np
 from tpunet.ckpt import Checkpointer
 from tpunet.config import TrainConfig
 from tpunet.data import (eval_batches, get_dataset, steps_per_epoch,
-                         train_batches)
+                         timed_batches, train_batches)
+from tpunet.obs import JsonlSink, Observability
+from tpunet.obs.perf import train_flops_per_unit
 from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
                              shard_host_batch)
 from tpunet.parallel.tp import rules_for, tree_shardings
@@ -73,7 +75,20 @@ class Trainer:
             state, self.mesh,
             rules_for(cfg.model, mesh=self.mesh, zero1=cfg.mesh.zero1,
                       fsdp=cfg.mesh.fsdp))
-        self.state = jax.device_put(state, state_sh)
+        if jax.process_count() > 1:
+            try:
+                self.state = jax.device_put(state, state_sh)
+            except ValueError:
+                # Older jax rejects device_put onto non-addressable
+                # (multi-controller global mesh) shardings; a jitted
+                # identity with pinned out_shardings reaches the same
+                # layout — every process holds the identical host
+                # state (deterministic same-seed init), which is
+                # exactly the replicated-input contract jit assumes.
+                self.state = jax.jit(lambda x: x,
+                                     out_shardings=state_sh)(state)
+        else:
+            self.state = jax.device_put(state, state_sh)
 
         # out_shardings pinned: without it XLA may propagate shard_map
         # internals (e.g. a 'seq'-sharded pos-embed gradient) onto the
@@ -187,7 +202,17 @@ class Trainer:
                     self.train_x, self.train_y.astype(np.int32), local)
 
         self._schedule = lr_schedule(cfg.optim, self.spe, cfg.epochs)
-        self.ckpt = Checkpointer(cfg.checkpoint)
+        # Observability (tpunet/obs/): per-step timing + stall split +
+        # windowed profiling. Constructed before the Checkpointer so
+        # checkpoint dispatch/wait can report into the same registry.
+        self.obs = Observability(
+            cfg.obs, profile_dir=cfg.profile_dir,
+            checkpoint_dir=cfg.checkpoint.directory,
+            unit="tokens" if self.is_lm else "examples")
+        from tpunet.models import num_params
+        self.obs.set_flops_per_unit(train_flops_per_unit(
+            cfg.model, cfg.data, n_params=num_params(state.params)))
+        self.ckpt = Checkpointer(cfg.checkpoint, obs=self.obs)
         self.guard = PreemptionGuard()
         self.global_step = 0
         self.start_epoch = 1
@@ -301,14 +326,49 @@ class Trainer:
         cfg = self.cfg
         every = cfg.log_every_steps
         acc = None
-        for bx, by in self._epoch_batches(epoch):
+        obs = self.obs
+        # Hoisted once per epoch: the disabled path pays exactly one
+        # branch per step, no spans, no timer objects, no wrapper
+        # around the batch iterator.
+        obs_hot = obs.hot
+        obs.begin_epoch(epoch)
+        batches = self._epoch_batches(epoch)
+        if obs_hot:
+            batches = timed_batches(
+                batches, obs.observe_data_wait,
+                wait_ctx=lambda: obs.span("tpunet/data_wait"))
+            sync = lambda: jax.block_until_ready(self.state)  # noqa: E731
+            step_timer = Timer()
+        for bx, by in batches:
             if self._stop_agreed():
                 break  # preemption: stop at a step boundary
             rng = step_key(cfg.seed, self.global_step)
-            gx, gy = shard_host_batch(self.mesh, bx, by.astype(np.int32))
-            self.state, m = self.train_step(self.state, gx, gy, rng)
+            if obs_hot:
+                # Profile-window edge check; the sync fence runs only
+                # on the two steps where a window opens/closes. The
+                # lap measures host-side dispatch wall time — under
+                # saturated async dispatch that converges to device
+                # step time; epoch totals are exact either way (the
+                # end-of-epoch summarize() is the window-edge sync).
+                obs.before_step(self.global_step, sync)
+                step_timer.lap()
+                with obs.step_span(self.global_step):
+                    gx, gy = shard_host_batch(self.mesh, bx,
+                                              by.astype(np.int32))
+                    self.state, m = self.train_step(self.state, gx, gy,
+                                                    rng)
+                obs.observe_step(self.global_step, step_timer.lap())
+            else:
+                gx, gy = shard_host_batch(self.mesh, bx,
+                                          by.astype(np.int32))
+                self.state, m = self.train_step(self.state, gx, gy, rng)
             acc = m if acc is None else M.accumulate(acc, m)
             self.global_step += 1
+            if obs_hot and obs.profiler.running:
+                # A window ending exactly at the epoch boundary must
+                # close HERE, not on the next epoch's first step —
+                # otherwise the trace bleeds across eval/checkpoint.
+                obs.profiler.on_step(self.global_step, sync)
             if every and self.global_step % every == 0:
                 # Opt-in per-step line (forces a device sync for the
                 # metric values; per-epoch-only, like the reference,
@@ -331,6 +391,13 @@ class Trainer:
         """--eval-only: load the saved weights and run one evaluation
         pass — the best-params checkpoint when present (what inference
         serves), else the last full train state."""
+        # Eval-only runs have no step loop to drive the windowed
+        # profiler, but a configured --profile-dir still means "trace
+        # this run": open the trace here; Trainer.close() (main.py's
+        # finally) stops and flushes it.
+        prof = self.obs.profiler
+        if prof.active and not prof.running:
+            prof.on_step(prof.start_step)
         best = self.ckpt.restore_best({
             "params": self.state.params,
             "batch_stats": self.state.batch_stats})
@@ -362,15 +429,16 @@ class Trainer:
             state = state.replace(params=state.ema_params,
                                   batch_stats=state.ema_batch_stats)
         acc = None
-        for bx, by, bm in eval_batches(
-                self.test_x, self.test_y,
-                global_batch=cfg.data.effective_eval_batch_size,
-                process_index=jax.process_index(),
-                process_count=jax.process_count()):
-            gx, gy, gm = shard_host_batch(
-                self.mesh, bx, by.astype(np.int32), bm)
-            m = self.eval_step(state, gx, gy, gm)
-            acc = m if acc is None else M.accumulate(acc, m)
+        with self.obs.span("tpunet/eval"):
+            for bx, by, bm in eval_batches(
+                    self.test_x, self.test_y,
+                    global_batch=cfg.data.effective_eval_batch_size,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count()):
+                gx, gy, gm = shard_host_batch(
+                    self.mesh, bx, by.astype(np.int32), bm)
+                m = self.eval_step(state, gx, gy, gm)
+                acc = m if acc is None else M.accumulate(acc, m)
         return M.summarize(acc if acc is not None else M.zeros_metrics())
 
     # ------------------------------------------------------------------
@@ -387,12 +455,17 @@ class Trainer:
         log0("")
         metrics_log = MetricsLogger(cfg.checkpoint.directory,
                                     resume=cfg.checkpoint.resume)
+        # obs records (obs_epoch / obs_step) share the run's
+        # metrics.jsonl; MetricsLogger already restricts writes to the
+        # coordinator.
+        self.obs.add_sink(JsonlSink(metrics_log))
         total = Timer()
         self.guard.install()
         try:
             for epoch in range(self.start_epoch, cfg.epochs + 1):
                 timer = Timer()
                 train_m = self.train_one_epoch(epoch)
+                train_secs = timer.elapsed()
                 if not np.isfinite(train_m["loss"]):
                     # Failure detection (SURVEY.md section 5: the
                     # reference has none — a NaN run would burn its full
@@ -431,6 +504,10 @@ class Trainer:
                         "train_loss": train_m["loss"],
                         "train_accuracy": train_m["accuracy"],
                     })
+                    self.obs.end_epoch(
+                        epoch=epoch, step=self.global_step,
+                        units=train_m["count"],
+                        train_seconds=train_secs, partial=True)
                     break
                 test_m = self.evaluate()
                 secs = timer.elapsed()
@@ -476,6 +553,12 @@ class Trainer:
                     })
                 self.start_epoch = epoch
                 self.ckpt.save_state(epoch, self._payload())
+                # After the save dispatches so this epoch's own
+                # checkpoint shows in its cumulative ckpt counters.
+                self.obs.end_epoch(
+                    epoch=epoch, step=self.global_step,
+                    units=train_m["count"], train_seconds=train_secs,
+                    eval_seconds=secs - train_secs)
         finally:
             self.guard.uninstall()
         log0("")
@@ -485,7 +568,15 @@ class Trainer:
         return self.history
 
     def close(self) -> None:
-        if self._prefetcher is not None:
-            self._prefetcher.close()
-            self._prefetcher = None
-        self.ckpt.close()
+        # Each cleanup independent (nested finally): a failing
+        # checkpoint flush cannot skip the profiler flush or the
+        # prefetcher shutdown, or vice versa.
+        try:
+            self.obs.close(lambda: jax.block_until_ready(self.state))
+        finally:
+            try:
+                if self._prefetcher is not None:
+                    self._prefetcher.close()
+                    self._prefetcher = None
+            finally:
+                self.ckpt.close()

@@ -91,8 +91,12 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
     DP/TP/PP-layout compute.
     """
 
+    # jax.named_scope labels below cost nothing at runtime (they apply
+    # at trace time) but carry through to HLO op names, so xprof traces
+    # attribute device time to fwd/bwd vs optimizer vs EMA phases.
     def finish(state, grads, stats):
-        state = state.apply_gradients(grads=grads, batch_stats=stats)
+        with jax.named_scope("tpunet_optimizer"):
+            state = state.apply_gradients(grads=grads, batch_stats=stats)
         if ema_decay > 0:
             # EMA tracks the POST-update params AND the BN running
             # stats (evaluating EMA weights against live stats would
@@ -100,10 +104,11 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
             ema = lambda old, new: jax.tree_util.tree_map(
                 lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
                 old, new)
-            state = state.replace(
-                ema_params=ema(state.ema_params, state.params),
-                ema_batch_stats=ema(state.ema_batch_stats,
-                                    state.batch_stats))
+            with jax.named_scope("tpunet_ema"):
+                state = state.replace(
+                    ema_params=ema(state.ema_params, state.params),
+                    ema_batch_stats=ema(state.ema_batch_stats,
+                                        state.batch_stats))
         return state
 
     def train_step(state: TrainState, x, y, rng):
@@ -112,8 +117,9 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
             params = jax.lax.with_sharding_constraint(params, gather_params)
 
         if accum == 1:
-            grads, stats, m = micro(params, state.batch_stats,
-                                    state.apply_fn, x, y, rng)
+            with jax.named_scope("tpunet_fwd_bwd"):
+                grads, stats, m = micro(params, state.batch_stats,
+                                        state.apply_fn, x, y, rng)
             return finish(state, grads, stats), m
 
         mb = x.shape[0] // accum
@@ -140,9 +146,10 @@ def _steps_from_micro(micro: Callable, accum: int, mesh,
             return (stats, gsum, M.accumulate(msum, m)), None
 
         gzero = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-        (stats, gsum, msum), _ = jax.lax.scan(
-            body, (state.batch_stats, gzero, M.zeros_metrics()),
-            (xs, ys, rngs))
+        with jax.named_scope("tpunet_fwd_bwd"):
+            (stats, gsum, msum), _ = jax.lax.scan(
+                body, (state.batch_stats, gzero, M.zeros_metrics()),
+                (xs, ys, rngs))
         if count_fn is not None:
             grads = gsum        # micro already normalized globally
         else:
@@ -333,6 +340,7 @@ def make_lm_eval_step(model_cfg: Optional[ModelConfig] = None,
                   and resolve_vocab_ce(model_cfg.vocab_ce, mesh,
                                        model_cfg.vocab_size) == "sharded")
 
+    @jax.named_scope("tpunet_eval_forward")
     def eval_step(state: TrainState, tokens, labels, mask):
         params = state.params
         if gather_params is not None:
@@ -372,6 +380,7 @@ def make_eval_step(data_cfg: DataConfig, gather_params=None) -> Callable:
     """
     preprocess = make_eval_preprocess(data_cfg)
 
+    @jax.named_scope("tpunet_eval_forward")
     def eval_step(state: TrainState, images_u8, labels, mask):
         params = state.params
         if gather_params is not None:

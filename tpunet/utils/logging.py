@@ -72,4 +72,30 @@ class MetricsLogger:
             return
         import json
         with open(self._path, "a") as f:
+            # ONE write of the full line: a crash can truncate the last
+            # record but never interleave two (append-mode writes of a
+            # single buffer are atomic for sane line sizes).
             f.write(json.dumps(record) + "\n")
+
+    @classmethod
+    def read_records(cls, path: str) -> list:
+        """Parse a ``metrics.jsonl`` back into dicts, tolerating a
+        truncated trailing line (the crash/preemption artifact the
+        append-per-record format can leave). A malformed line anywhere
+        *else* is real corruption and raises."""
+        import json
+        records = []
+        with open(path) as f:
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    break  # torn trailing write: drop it
+                raise ValueError(
+                    f"{path}:{i + 1}: malformed record mid-file (only "
+                    f"the final line may be truncated)")
+        return records
