@@ -1,0 +1,520 @@
+#!/usr/bin/env python
+"""Live terminal dashboard over the tpunet obs record stream.
+
+Three input modes, one renderer (``tpunet.obs.summary.summarize`` —
+the same summarizer ``obs_report.py`` uses, so live and post-mortem
+views can never disagree):
+
+    # live-tail a run's metrics.jsonl (follows appends; tolerates the
+    # torn trailing line a crash or an in-flight write leaves)
+    python scripts/obs_dashboard.py checkpoints/
+
+    # one render, no follow loop (CI / scripting)
+    python scripts/obs_dashboard.py checkpoints/ --once
+
+    # receive line-JSON POSTs from a run started with
+    #   train.py --obs-http http://HOST:8321/
+    python scripts/obs_dashboard.py --listen 8321
+
+``--html report.html`` writes a self-contained static report (stat
+tiles, per-epoch throughput and step-time-trend SVG charts, alert and
+epoch tables; light/dark via CSS custom properties) instead of — or,
+in follow mode, alongside — the terminal view. GET on the ``--listen``
+port returns the current text render, so ``curl :8321`` is a remote
+status line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html as html_mod
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width=32) -> str:
+    """Unicode block sparkline, downsampled to ``width`` buckets."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        per = -(-len(vals) // width)
+        vals = [sum(vals[i:i + per]) / len(vals[i:i + per])
+                for i in range(0, len(vals), per)]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(SPARK[1 + int((v - lo) / span * (len(SPARK) - 2))]
+                   for v in vals)
+
+
+def _fmt_rate(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v / 1e3:.1f}k" if v >= 10_000 else f"{v:.0f}"
+
+
+# ---------------------------------------------------------------------------
+# terminal view
+# ---------------------------------------------------------------------------
+
+
+def render_terminal(summary: dict, source: str, last: int = 10) -> str:
+    """One full-screen text frame from a summarize() dict."""
+    totals = summary["totals"]
+    obs = summary["obs_epochs"]
+    windows = summary["step_windows"]
+    alerts = summary["alerts"]
+    out = [f"tpunet obs dashboard — {source} — "
+           f"{time.strftime('%H:%M:%S')}"]
+
+    head = []
+    if obs:
+        r = obs[-1]
+        head.append(f"epoch {r['epoch']} step {r.get('step', '?')}")
+    thr = totals.get("tokens_per_sec", totals.get("examples_per_sec"))
+    if thr is not None:
+        unit = "tok/s" if "tokens_per_sec" in totals else "ex/s"
+        head.append(f"{_fmt_rate(thr)} {unit}")
+    if totals.get("mfu") is not None:
+        head.append(f"MFU {totals['mfu']:.3f}")
+    if "stall_frac" in totals:
+        head.append(f"stall {100 * totals['stall_frac']:.1f}%")
+    if totals.get("live_processes") is not None:
+        head.append(f"procs {totals['live_processes']}")
+    if totals.get("peak_bytes_in_use") is not None:
+        head.append(f"mem {totals['peak_bytes_in_use'] / 2**30:.2f} GiB")
+    if head:
+        out.append("  ".join(head))
+    out.append("")
+
+    if alerts:
+        out.append(f"ALERTS ({len(alerts)}):")
+        for a in alerts[-5:]:
+            out.append(f"  step {a.get('step', '?'):>8} "
+                       f"[{a.get('severity', 'warn')}] "
+                       f"{a.get('reason', '?')}")
+        out.append("")
+
+    if obs:
+        out.append(f"{'ep':>4} {'steps':>6} {'p50ms':>8} {'p90ms':>8} "
+                   f"{'p99ms':>8} {'stall%':>7} {'thruput':>9} {'mfu':>6}")
+        for r in obs[-last:]:
+            t = r.get("tokens_per_sec", r.get("examples_per_sec"))
+            p50 = r.get("step_time_p50_s")
+            p90 = r.get("step_time_p90_s")
+            p99 = r.get("step_time_p99_s")
+            mfu = r.get("mfu")
+            out.append(
+                f"{r['epoch']:>4} {r.get('steps', 0):>6} "
+                f"{'-' if p50 is None else f'{p50 * 1e3:8.1f}'} "
+                f"{'-' if p90 is None else f'{p90 * 1e3:8.1f}'} "
+                f"{'-' if p99 is None else f'{p99 * 1e3:8.1f}'} "
+                f"{100 * r.get('stall_frac', 0.0):>6.1f}% "
+                f"{_fmt_rate(t):>9} "
+                f"{'-' if mfu is None else f'{mfu:6.3f}'}")
+        thr_series = [r.get("tokens_per_sec", r.get("examples_per_sec"))
+                      for r in obs]
+        spark = sparkline(thr_series)
+        if spark:
+            out.append(f"throughput/epoch  {spark}")
+        out.append("")
+
+    if windows:
+        p50s = [w["step_time_p50_s"] for w in windows]
+        out.append(f"step-time trend ({windows[0]['step_lo']}"
+                   f"→{windows[-1]['step_hi']}, p50 per window): "
+                   f"{sparkline(p50s)}")
+        out.append(f"  first {p50s[0] * 1e3:.1f}ms  "
+                   f"last {p50s[-1] * 1e3:.1f}ms  "
+                   f"worst p99 {max(w['step_time_p99_s'] for w in windows) * 1e3:.1f}ms")
+
+    if len(out) <= 3:
+        out.append("waiting for records...")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# static HTML report
+# ---------------------------------------------------------------------------
+
+# Chart palette: the dataviz reference categorical slots 1-2 (blue,
+# orange — adjacent-pair CVD-validated in both modes) plus the status
+# red for alerts; text/surface tokens likewise, stepped per mode.
+_CSS = """
+:root { color-scheme: light dark; }
+body {
+  margin: 0; padding: 24px; background: #fcfcfb; color: #0b0b0b;
+  font: 14px/1.5 system-ui, -apple-system, sans-serif;
+  --surface: #fcfcfb; --text-2: #52514e; --grid: #e8e7e3;
+  --s1: #2a78d6; --s2: #eb6834; --bad: #e34948;
+}
+@media (prefers-color-scheme: dark) {
+  body { background: #1a1a19; color: #fff;
+         --surface: #1a1a19; --text-2: #c3c2b7; --grid: #343431;
+         --s1: #3987e5; --s2: #d95926; --bad: #e66767; }
+}
+h1 { font-size: 18px; margin: 0 0 4px; }
+.sub { color: var(--text-2); margin: 0 0 20px; }
+.tiles { display: flex; flex-wrap: wrap; gap: 12px; margin: 0 0 24px; }
+.tile { border: 1px solid var(--grid); border-radius: 8px;
+        padding: 12px 16px; min-width: 110px; }
+.tile .v { font-size: 22px; font-weight: 600; }
+.tile .k { color: var(--text-2); font-size: 12px; }
+.card { border: 1px solid var(--grid); border-radius: 8px;
+        padding: 16px; margin: 0 0 20px; }
+.card h2 { font-size: 14px; margin: 0 0 8px; }
+.legend { color: var(--text-2); font-size: 12px; margin: 0 0 8px; }
+.legend .sw { display: inline-block; width: 10px; height: 10px;
+              border-radius: 3px; vertical-align: -1px; margin-right: 4px; }
+table { border-collapse: collapse; width: 100%; font-size: 13px; }
+th { text-align: right; color: var(--text-2); font-weight: 500; }
+th:first-child, td:first-child { text-align: left; }
+td { text-align: right; }
+th, td { padding: 4px 8px; border-bottom: 1px solid var(--grid); }
+.alert { color: var(--bad); }
+svg text { fill: var(--text-2); font-size: 11px; }
+svg .gridline { stroke: var(--grid); stroke-width: 1; }
+"""
+
+
+def _svg_line_chart(series, width=640, height=180, fmt=lambda v: f"{v:g}"):
+    """Minimal single-axis SVG line chart. ``series`` is a list of
+    (css_color_var, label, [(x, y), ...]); one shared y scale, 2px
+    lines, 8px hover targets with native <title> tooltips."""
+    pad_l, pad_r, pad_t, pad_b = 48, 12, 8, 22
+    pts = [p for _, _, ps in series for p in ps if p[1] is not None]
+    if not pts:
+        return ""
+    xs = [p[0] for p in pts]
+    ys = [p[1] for p in pts]
+    x_lo, x_hi = min(xs), max(xs)
+    y_lo, y_hi = min(ys), max(ys)
+    if y_lo > 0 and y_lo < y_hi * 0.5:
+        y_lo = 0.0              # near-zero floors: anchor at zero
+    x_span = (x_hi - x_lo) or 1.0
+    y_span = (y_hi - y_lo) or 1.0
+    iw = width - pad_l - pad_r
+    ih = height - pad_t - pad_b
+
+    def sx(x):
+        return pad_l + (x - x_lo) / x_span * iw
+
+    def sy(y):
+        return pad_t + ih - (y - y_lo) / y_span * ih
+
+    parts = [f'<svg viewBox="0 0 {width} {height}" role="img" '
+             f'style="width:100%;height:auto">']
+    for frac in (0.0, 0.5, 1.0):
+        y = pad_t + ih * frac
+        val = y_hi - y_span * frac
+        parts.append(f'<line class="gridline" x1="{pad_l}" y1="{y:.1f}" '
+                     f'x2="{width - pad_r}" y2="{y:.1f}"/>')
+        parts.append(f'<text x="{pad_l - 6}" y="{y + 4:.1f}" '
+                     f'text-anchor="end">{fmt(val)}</text>')
+    parts.append(f'<text x="{pad_l}" y="{height - 6}">{fmt_x(x_lo)}</text>')
+    parts.append(f'<text x="{width - pad_r}" y="{height - 6}" '
+                 f'text-anchor="end">{fmt_x(x_hi)}</text>')
+    for color, label, ps in series:
+        ps = [p for p in ps if p[1] is not None]
+        if not ps:
+            continue
+        d = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in ps)
+        parts.append(f'<polyline points="{d}" fill="none" '
+                     f'stroke="var({color})" stroke-width="2" '
+                     f'stroke-linejoin="round"/>')
+        for x, y in ps:
+            parts.append(
+                f'<circle cx="{sx(x):.1f}" cy="{sy(y):.1f}" r="8" '
+                f'fill="transparent" stroke="none">'
+                f'<title>{html_mod.escape(label)} @ {fmt_x(x)}: '
+                f'{fmt(y)}</title></circle>')
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def fmt_x(x) -> str:
+    return f"{int(x):,}"
+
+
+def render_html(summary: dict, source: str) -> str:
+    totals = summary["totals"]
+    obs = summary["obs_epochs"]
+    epochs = summary["epochs"]
+    windows = summary["step_windows"]
+    alerts = summary["alerts"]
+    e = html_mod.escape
+
+    tiles = []
+
+    def tile(value, key):
+        tiles.append(f'<div class="tile"><div class="v">{e(str(value))}'
+                     f'</div><div class="k">{e(key)}</div></div>')
+
+    thr = totals.get("tokens_per_sec", totals.get("examples_per_sec"))
+    if thr is not None:
+        tile(_fmt_rate(thr),
+             "tokens/s" if "tokens_per_sec" in totals else "examples/s")
+    if totals.get("mfu") is not None:
+        tile(f"{totals['mfu']:.3f}", "MFU")
+    if "stall_frac" in totals:
+        tile(f"{100 * totals['stall_frac']:.1f}%", "input stall")
+    if totals.get("peak_bytes_in_use") is not None:
+        tile(f"{totals['peak_bytes_in_use'] / 2**30:.2f} GiB",
+             "peak device mem")
+    if totals.get("live_processes") is not None:
+        tile(totals["live_processes"], "live processes")
+    tile(totals.get("alerts", 0), "alerts")
+
+    cards = []
+    if obs:
+        pts = [(r["epoch"],
+                r.get("tokens_per_sec", r.get("examples_per_sec")))
+               for r in obs]
+        chart = _svg_line_chart([("--s1", "throughput", pts)],
+                                fmt=_fmt_rate)
+        cards.append('<div class="card"><h2>Throughput per epoch</h2>'
+                     + chart + "</div>")
+    if windows:
+        p50 = [(w["step_lo"], w["step_time_p50_s"] * 1e3) for w in windows]
+        p99 = [(w["step_lo"], w["step_time_p99_s"] * 1e3) for w in windows]
+        chart = _svg_line_chart(
+            [("--s1", "p50", p50), ("--s2", "p99", p99)],
+            fmt=lambda v: f"{v:.1f}ms")
+        cards.append(
+            '<div class="card"><h2>Step time trend (per obs_step window)'
+            '</h2><div class="legend">'
+            '<span class="sw" style="background:var(--s1)"></span>p50'
+            '&nbsp;&nbsp;'
+            '<span class="sw" style="background:var(--s2)"></span>p99'
+            "</div>" + chart + "</div>")
+
+    if alerts:
+        rows = "".join(
+            f'<tr class="alert"><td>{e(str(a.get("reason", "?")))}</td>'
+            f'<td>{a.get("step", "?")}</td>'
+            f'<td>{e(str(a.get("severity", "warn")))}</td>'
+            f'<td style="text-align:left">'
+            f'{e(json.dumps({k: v for k, v in a.items() if k not in ("kind", "reason", "step", "severity")}))}'
+            f"</td></tr>" for a in alerts)
+        cards.append('<div class="card"><h2>Alerts</h2><table>'
+                     "<tr><th>reason</th><th>step</th><th>severity</th>"
+                     '<th style="text-align:left">detail</th></tr>'
+                     + rows + "</table></div>")
+
+    if epochs or obs:
+        by_epoch = {r["epoch"]: dict(r) for r in epochs}
+        for r in obs:
+            by_epoch.setdefault(r["epoch"], {}).update(r)
+        rows = []
+        for ep in sorted(by_epoch):
+            r = by_epoch[ep]
+            t = r.get("tokens_per_sec", r.get("examples_per_sec"))
+            p50 = r.get("step_time_p50_s")
+            rows.append(
+                f"<tr><td>{ep}</td>"
+                f"<td>{r.get('seconds', r.get('train_seconds', 0)):.1f}</td>"
+                f"<td>{r.get('train_loss', float('nan')):.4f}</td>"
+                f"<td>{r.get('test_accuracy', float('nan')):.4f}</td>"
+                f"<td>{'-' if t is None else _fmt_rate(t)}</td>"
+                f"<td>{'-' if p50 is None else f'{p50 * 1e3:.1f}'}</td>"
+                f"<td>{100 * r.get('stall_frac', 0.0):.1f}%</td></tr>")
+        cards.append('<div class="card"><h2>Epochs</h2><table>'
+                     "<tr><th>ep</th><th>secs</th><th>train loss</th>"
+                     "<th>test acc</th><th>thruput</th><th>p50 ms</th>"
+                     "<th>stall</th></tr>" + "".join(rows)
+                     + "</table></div>")
+
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            "<meta name='viewport' content='width=device-width,"
+            "initial-scale=1'>"
+            f"<title>tpunet obs — {e(source)}</title>"
+            f"<style>{_CSS}</style></head><body>"
+            f"<h1>tpunet observability report</h1>"
+            f'<p class="sub">{e(source)} — generated '
+            f"{time.strftime('%Y-%m-%d %H:%M:%S')}</p>"
+            f'<div class="tiles">{"".join(tiles)}</div>'
+            + "".join(cards) + "</body></html>")
+
+
+# ---------------------------------------------------------------------------
+# record sources: file tail / HTTP listener
+# ---------------------------------------------------------------------------
+
+
+class RecordBuffer:
+    """Thread-safe accumulator both sources feed.
+
+    Bounded: a multi-day run with --obs-step-every 1 would otherwise
+    grow (and re-summarize) an unbounded list. Epoch-grained records
+    and alerts are small and all kept; high-volume ``obs_step``
+    records are compacted to the most recent ``max_steps`` — exactly
+    what the trend view renders anyway."""
+
+    def __init__(self, max_steps: int = 20_000):
+        self._records: list = []
+        self._max_steps = max_steps
+        self._lock = threading.Lock()
+
+    def feed(self, records) -> None:
+        with self._lock:
+            self._records.extend(records)
+            n_steps = sum(1 for r in self._records
+                          if r.get("kind") == "obs_step")
+            if n_steps > 2 * self._max_steps:
+                drop = n_steps - self._max_steps
+                kept = []
+                for r in self._records:
+                    if drop > 0 and r.get("kind") == "obs_step":
+                        drop -= 1
+                        continue
+                    kept.append(r)
+                self._records = kept
+
+    def clear(self) -> None:
+        """Forget everything — the tailed file was truncated by a
+        fresh run; merging two runs' records would corrupt every
+        aggregate."""
+        with self._lock:
+            self._records = []
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._records)
+
+
+def serve_http(port: int, buf: RecordBuffer, source_name: str):
+    """Line-JSON ingest endpoint matching HttpLineTransport: POST
+    bodies are newline-delimited records; GET returns the current
+    text render."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tpunet.obs.summary import summarize
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            records = []
+            for line in body.splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass    # one bad line must not poison the stream
+            buf.feed(records)
+            self.send_response(204)
+            self.end_headers()
+
+        def do_GET(self):
+            text = render_terminal(summarize(buf.snapshot()),
+                                   source_name)
+            data = (text + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?",
+                    help="metrics.jsonl or a directory containing one; "
+                         "omit with --listen")
+    ap.add_argument("--listen", type=int, metavar="PORT",
+                    help="receive line-JSON POSTs (train.py "
+                         "--obs-http http://HOST:PORT/) instead of "
+                         "tailing a file")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no follow loop)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh/poll period in seconds (default 2)")
+    ap.add_argument("--html", metavar="OUT",
+                    help="write a static self-contained HTML report "
+                         "(re-written every refresh in follow mode)")
+    ap.add_argument("--last", type=int, default=10,
+                    help="epochs shown in the terminal table")
+    args = ap.parse_args(argv)
+
+    if (args.path is None) == (args.listen is None):
+        ap.error("give a metrics.jsonl path OR --listen PORT")
+
+    from tpunet.obs.summary import summarize
+    from tpunet.utils.logging import MetricsLogger
+
+    buf = RecordBuffer()
+    path = None
+    offset = 0
+    if args.listen is not None:
+        source = f"http://:{args.listen}"
+        serve_http(args.listen, buf, source)
+    else:
+        path = args.path
+        if os.path.isdir(path):
+            path = os.path.join(path, "metrics.jsonl")
+        source = path
+        if args.once and not os.path.isfile(path):
+            print(f"no metrics.jsonl at {path}", file=sys.stderr)
+            return 1
+
+    def refresh():
+        nonlocal offset
+        if path is not None:
+            records, offset, reset = MetricsLogger.tail_records(
+                path, offset)
+            if reset:
+                # Fresh run truncated the file underneath us: drop the
+                # old run's records (already re-read from the start),
+                # or every aggregate would straddle two runs.
+                buf.clear()
+            buf.feed(records)
+        return summarize(buf.snapshot())
+
+    summary = refresh()
+    if args.html:
+        with open(args.html, "w") as f:
+            f.write(render_html(summary, source))
+    if args.once:
+        print(render_terminal(summary, source, last=args.last))
+        return 0
+
+    try:
+        while True:
+            # Full-frame redraw: clear + home, like top(1).
+            sys.stdout.write("\x1b[2J\x1b[H")
+            sys.stdout.write(render_terminal(summary, source,
+                                             last=args.last) + "\n")
+            sys.stdout.flush()
+            if args.html:
+                tmp = args.html + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(render_html(summary, source))
+                os.replace(tmp, args.html)
+            time.sleep(args.interval)
+            summary = refresh()
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,16 +4,22 @@
 Usage:
     python scripts/obs_report.py checkpoints/metrics.jsonl
     python scripts/obs_report.py checkpoints/          # finds metrics.jsonl
+    python scripts/obs_report.py checkpoints/ --json   # machine-readable
 
 Prints the per-epoch training table, the step-time percentile /
-input-stall summary from the ``obs_epoch`` records, and device-memory
-high-water marks. Tolerates a truncated trailing line (a crashed or
-preempted run's artifact) via ``MetricsLogger.read_records``.
+input-stall summary from the ``obs_epoch`` records, the per-window
+``obs_step`` step-time trend, any ``obs_alert`` records, and
+device-memory high-water marks. ``--json`` emits the same summary as
+one JSON object (the ``tpunet.obs.summary.summarize`` schema — the
+exact structure the live dashboard renders, so the two views cannot
+drift). Tolerates a truncated trailing line (a crashed or preempted
+run's artifact) via ``MetricsLogger.read_records``.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -28,11 +34,13 @@ def _fmt_ms(v):
     return "-" if v is None else f"{v * 1e3:.1f}"
 
 
-def report(records: list) -> list:
-    """Build the report lines from parsed metrics.jsonl records."""
-    epochs = [r for r in records if "kind" not in r and "epoch" in r]
-    obs = [r for r in records if r.get("kind") == "obs_epoch"]
-    steps = [r for r in records if r.get("kind") == "obs_step"]
+def render(summary: dict) -> list:
+    """Text report lines from a ``summarize()`` dict."""
+    epochs = summary["epochs"]
+    obs = summary["obs_epochs"]
+    windows = summary["step_windows"]
+    alerts = summary["alerts"]
+    totals = summary["totals"]
     lines = []
 
     if epochs:
@@ -58,7 +66,6 @@ def report(records: list) -> list:
                      f"{'p99ms':>8} {'stall_s':>8} {'stall%':>7} "
                      f"{'mfu':>6} {'procs':>6}")
         for r in obs:
-            mfu = r.get("mfu")
             lines.append(
                 f"{r['epoch']:>4} {r.get('steps', 0):>6} "
                 f"{_fmt_ms(r.get('step_time_p50_s')):>8} "
@@ -66,40 +73,64 @@ def report(records: list) -> list:
                 f"{_fmt_ms(r.get('step_time_p99_s')):>8} "
                 f"{_fmt_s(r.get('input_stall_s'), 2):>8} "
                 f"{100 * r.get('stall_frac', 0.0):>6.1f}% "
-                f"{_fmt_s(mfu, 3):>6} "
+                f"{_fmt_s(r.get('mfu'), 3):>6} "
                 f"{r.get('live_processes', 1):>6}")
-        total_stall = sum(r.get("input_stall_s", 0.0) for r in obs)
-        total_train = sum(r.get("train_seconds", 0.0) for r in obs)
-        frac = total_stall / total_train if total_train else 0.0
-        lines.append(f"run input-stall: {total_stall:.2f}s of "
-                     f"{total_train:.2f}s train time ({100 * frac:.1f}%)")
-        peaks = [m.get("peak_bytes_in_use")
-                 for r in obs for m in r.get("device_memory", [])
-                 if m.get("peak_bytes_in_use") is not None]
-        if peaks:
+        frac = totals.get("stall_frac", 0.0)
+        lines.append(f"run input-stall: "
+                     f"{totals.get('input_stall_s', 0.0):.2f}s of "
+                     f"{totals.get('train_seconds', 0.0):.2f}s train "
+                     f"time ({100 * frac:.1f}%)")
+        peak = totals.get("peak_bytes_in_use")
+        if peak is not None:
             lines.append(f"device memory high-water: "
-                         f"{max(peaks) / 2**30:.2f} GiB")
+                         f"{peak / 2**30:.2f} GiB")
         else:
             lines.append("device memory: backend reports no allocator "
                          "stats (CPU)")
 
-    if steps:
+    if windows:
         lines.append("")
-        times = sorted(r["step_time_s"] for r in steps
-                       if "step_time_s" in r)
-        mid = times[len(times) // 2]
-        lines.append(f"== obs_step samples: {len(steps)} "
-                     f"(median {mid * 1e3:.1f}ms) ==")
+        lines.append("== step-time trend (obs_step windows) ==")
+        lines.append(f"{'steps':>15} {'n':>5} {'mean_ms':>8} "
+                     f"{'p50ms':>8} {'p99ms':>8} {'wait_ms':>8}")
+        for w in windows:
+            span = f"{w['step_lo']}-{w['step_hi']}"
+            lines.append(
+                f"{span:>15} {w['samples']:>5} "
+                f"{_fmt_ms(w['step_time_mean_s']):>8} "
+                f"{_fmt_ms(w['step_time_p50_s']):>8} "
+                f"{_fmt_ms(w['step_time_p99_s']):>8} "
+                f"{_fmt_ms(w['data_wait_mean_s']):>8}")
+
+    if alerts:
+        lines.append("")
+        lines.append(f"== alerts ({len(alerts)}) ==")
+        for a in alerts:
+            extras = {k: v for k, v in a.items()
+                      if k not in ("kind", "reason", "step", "severity")}
+            lines.append(f"  step {a.get('step', '?'):>8} "
+                         f"[{a.get('severity', 'warn')}] "
+                         f"{a.get('reason', '?')} {extras}")
 
     if not lines:
         lines.append("no records found")
     return lines
 
 
+def report(records: list) -> list:
+    """Build the report lines from parsed metrics.jsonl records."""
+    from tpunet.obs.summary import summarize
+    return render(summarize(records))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="metrics.jsonl, or a directory "
                                  "containing one (e.g. checkpoints/)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable summary (the "
+                         "tpunet.obs.summary.summarize schema) instead "
+                         "of the text tables")
     args = ap.parse_args(argv)
     path = args.path
     if os.path.isdir(path):
@@ -108,7 +139,12 @@ def main(argv=None) -> int:
         print(f"no metrics.jsonl at {path}", file=sys.stderr)
         return 1
     from tpunet.utils.logging import MetricsLogger
-    for line in report(MetricsLogger.read_records(path)):
+    records = MetricsLogger.read_records(path)
+    if args.json:
+        from tpunet.obs.summary import summarize
+        print(json.dumps(summarize(records), indent=2))
+        return 0
+    for line in report(records):
         print(line)
     return 0
 

@@ -1,0 +1,292 @@
+"""Dashboard / summarizer / tail path: live rendering from an
+append-in-progress metrics.jsonl (torn trailing line included), the
+HTTP listen mode fed by the real HttpLineTransport, the shared
+summarizer's step-window trend, obs_report --json, and the registry
+satellites (histogram reservoir bound, snapshot collision rules)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from tpunet.obs.registry import Histogram, Registry
+from tpunet.obs.summary import step_windows, summarize
+from tpunet.utils.logging import MetricsLogger
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _import_script(name):
+    sys.path.insert(0, SCRIPTS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _write_run(path, n_epochs=3, torn=True):
+    with open(path, "w") as f:
+        for ep in range(1, n_epochs + 1):
+            f.write(json.dumps({
+                "epoch": ep, "seconds": 2.0, "step": 4 * ep,
+                "train_loss": 1.0 / ep, "train_accuracy": 0.5,
+                "test_loss": 1.1 / ep, "test_accuracy": 0.6,
+                "tokens_per_sec": 1000.0 + ep}) + "\n")
+            f.write(json.dumps({
+                "kind": "obs_epoch", "epoch": ep, "step": 4 * ep,
+                "train_seconds": 1.5, "steps": 4,
+                "step_time_p50_s": 0.01, "step_time_p90_s": 0.02,
+                "step_time_p99_s": 0.03, "input_stall_s": 0.1,
+                "stall_frac": 0.0625, "tokens_per_sec": 1000.0 + ep,
+                "mfu": 0.5, "live_processes": 1,
+                "device_memory": [{"device": 0,
+                                   "peak_bytes_in_use": 2**30}]}) + "\n")
+            for s in range(4 * (ep - 1), 4 * ep):
+                f.write(json.dumps({
+                    "kind": "obs_step", "step": s,
+                    "step_time_s": 0.01 + 0.001 * s,
+                    "data_wait_s": 0.001}) + "\n")
+        f.write(json.dumps({
+            "kind": "obs_alert", "reason": "step_stall", "step": 7,
+            "severity": "fatal", "step_time_s": 0.9}) + "\n")
+        if torn:
+            f.write('{"kind": "obs_epoch", "epo')      # write in flight
+
+
+# ---------------------------------------------------------------------------
+# tail_records
+# ---------------------------------------------------------------------------
+
+
+def test_tail_records_incremental_and_torn_line(tmp_path):
+    p = str(tmp_path / "metrics.jsonl")
+    with open(p, "w") as f:
+        f.write('{"epoch": 1}\n{"epoch": 2}\n{"epoch": 3')   # torn
+    recs, off, reset = MetricsLogger.tail_records(p, 0)
+    assert [r["epoch"] for r in recs] == [1, 2] and not reset
+    # the torn tail was NOT consumed; completing it yields it next poll
+    with open(p, "a") as f:
+        f.write('}\n{"epoch": 4}\n')
+    recs, off, reset = MetricsLogger.tail_records(p, off)
+    assert [r["epoch"] for r in recs] == [3, 4] and not reset
+    recs, off2, reset = MetricsLogger.tail_records(p, off)
+    assert recs == [] and off2 == off and not reset
+
+
+def test_tail_records_signals_reset_on_truncation(tmp_path):
+    p = str(tmp_path / "metrics.jsonl")
+    with open(p, "w") as f:
+        f.write('{"epoch": 1}\n{"epoch": 2}\n')
+    _, off, _ = MetricsLogger.tail_records(p, 0)
+    with open(p, "w") as f:                   # fresh run truncates
+        f.write('{"epoch": 1}\n')
+    recs, _, reset = MetricsLogger.tail_records(p, off)
+    # the reset flag is the caller's cue to drop old-run state
+    assert [r["epoch"] for r in recs] == [1] and reset
+
+
+def test_tail_records_missing_file():
+    recs, off, reset = MetricsLogger.tail_records("/nonexistent/x.jsonl",
+                                                  0)
+    assert recs == [] and off == 0 and not reset
+
+
+# ---------------------------------------------------------------------------
+# summarizer
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_sections_and_totals(tmp_path):
+    p = str(tmp_path / "metrics.jsonl")
+    _write_run(p)
+    s = summarize(MetricsLogger.read_records(p))
+    assert len(s["epochs"]) == 3 and len(s["obs_epochs"]) == 3
+    assert s["alerts"][0]["reason"] == "step_stall"
+    t = s["totals"]
+    assert t["stall_frac"] == pytest.approx(0.3 / 4.5, abs=1e-4)
+    assert t["tokens_per_sec"] == 1003.0
+    assert t["peak_bytes_in_use"] == 2**30
+    assert t["alerts"] == 1
+
+
+def test_step_windows_show_a_trend():
+    steps = [{"kind": "obs_step", "step": s,
+              "step_time_s": 0.01 if s < 50 else 0.02}
+             for s in range(100)]
+    ws = step_windows(steps, n_windows=10)
+    assert len(ws) == 10
+    assert ws[0]["step_lo"] == 0 and ws[-1]["step_hi"] == 99
+    assert sum(w["samples"] for w in ws) == 100
+    # the slowdown at step 50 is visible in the window means
+    assert ws[0]["step_time_mean_s"] == pytest.approx(0.01)
+    assert ws[-1]["step_time_mean_s"] == pytest.approx(0.02)
+
+
+# ---------------------------------------------------------------------------
+# obs_report --json / obs_dashboard
+# ---------------------------------------------------------------------------
+
+
+def test_obs_report_json_output(tmp_path, capsys):
+    p = str(tmp_path / "metrics.jsonl")
+    _write_run(p)
+    obs_report = _import_script("obs_report")
+    assert obs_report.main([p, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert set(out) == {"epochs", "obs_epochs", "step_windows",
+                        "alerts", "totals"}
+    assert out["totals"]["obs_steps"] == 12
+
+
+def test_obs_report_text_has_trend_and_alert_sections(tmp_path, capsys):
+    p = str(tmp_path / "metrics.jsonl")
+    _write_run(p)
+    obs_report = _import_script("obs_report")
+    assert obs_report.main([p]) == 0
+    out = capsys.readouterr().out
+    assert "== step-time trend (obs_step windows) ==" in out
+    assert "== alerts (1) ==" in out
+    assert "step_stall" in out
+
+
+def test_dashboard_once_renders_live_file(tmp_path, capsys):
+    p = str(tmp_path / "metrics.jsonl")
+    _write_run(p, torn=True)                  # append in flight
+    dash = _import_script("obs_dashboard")
+    assert dash.main([str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "tpunet obs dashboard" in out
+    assert "ALERTS (1)" in out
+    assert "step-time trend" in out
+    assert "MFU 0.500" in out
+
+
+def test_dashboard_html_report(tmp_path, capsys):
+    p = str(tmp_path / "metrics.jsonl")
+    _write_run(p)
+    out_html = str(tmp_path / "report.html")
+    dash = _import_script("obs_dashboard")
+    assert dash.main([p, "--once", "--html", out_html]) == 0
+    html = open(out_html).read()
+    assert "<svg" in html and "polyline" in html
+    assert "step_stall" in html
+    assert "Throughput per epoch" in html
+    assert "prefers-color-scheme: dark" in html
+
+
+def test_record_buffer_bounds_step_records_keeps_the_rest():
+    dash = _import_script("obs_dashboard")
+    buf = dash.RecordBuffer(max_steps=100)
+    buf.feed([{"kind": "obs_epoch", "epoch": 1}])
+    buf.feed([{"kind": "obs_step", "step": s, "step_time_s": 0.01}
+              for s in range(500)])
+    buf.feed([{"kind": "obs_alert", "reason": "nan_loss", "step": 9}])
+    records = buf.snapshot()
+    steps = [r for r in records if r.get("kind") == "obs_step"]
+    # compacted to the most recent window, oldest dropped first
+    assert 100 <= len(steps) <= 200
+    assert steps[-1]["step"] == 499
+    # epoch-grained records and alerts are never compacted away
+    assert [r for r in records if r.get("kind") == "obs_epoch"]
+    assert [r for r in records if r.get("kind") == "obs_alert"]
+    buf.clear()
+    assert buf.snapshot() == []
+
+
+def test_dashboard_listen_mode_roundtrip(tmp_path, capsys):
+    """The full live path: HttpLineTransport (the exporter's wire
+    format) -> dashboard HTTP listener -> rendered frame."""
+    import urllib.request
+
+    dash = _import_script("obs_dashboard")
+    buf = dash.RecordBuffer()
+    server = dash.serve_http(0, buf, "test")
+    port = server.server_address[1]
+    try:
+        from tpunet.obs.export import HttpLineTransport
+        tx = HttpLineTransport(f"http://127.0.0.1:{port}/", timeout=5.0)
+        tx.send({"kind": "obs_epoch", "epoch": 1, "step": 4,
+                 "steps": 4, "tokens_per_sec": 500.0,
+                 "stall_frac": 0.01, "train_seconds": 1.0,
+                 "input_stall_s": 0.01, "live_processes": 1})
+        tx.send({"kind": "obs_alert", "reason": "nan_loss", "step": 4,
+                 "severity": "fatal"})
+        assert len(buf.snapshot()) == 2
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=5.0) as r:
+            page = r.read().decode()
+        assert "tpunet obs dashboard" in page
+        assert "nan_loss" in page
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# registry satellites: reservoir bound + snapshot collisions
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_exact_below_bound_reservoir_above():
+    h = Histogram(max_samples=100)
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert not h.saturated
+    s = h.summary()
+    assert "approx" not in s
+    assert s["p50"] == pytest.approx(50.5)    # exact below the bound
+    for v in range(101, 10001):
+        h.observe(float(v))
+    assert h.saturated and len(h.values) == 100
+    s = h.summary()
+    assert s["count"] == 10000                # count/mean stay exact
+    assert s["mean"] == pytest.approx(5000.5)
+    assert h.total == pytest.approx(sum(range(1, 10001)))
+    assert s["approx"] == 1
+    # the reservoir is a uniform sample: p50 lands near the true median
+    assert s["p50"] == pytest.approx(5000.5, rel=0.15)
+    h.reset()
+    assert len(h) == 0 and h.summary() == {} and not h.saturated
+
+
+def test_histogram_reservoir_is_deterministic():
+    def run():
+        h = Histogram(max_samples=10)
+        for v in range(1000):
+            h.observe(float(v))
+        return list(h.values)
+    assert run() == run()
+
+
+def test_registry_rejects_cross_family_name_reuse():
+    reg = Registry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="already registered as a "
+                                         "counter"):
+        reg.gauge("x")
+    with pytest.raises(ValueError, match="counter"):
+        reg.histogram("x")
+    reg.counter("x").inc()                    # same family: fine
+    reg.gauge("y")
+    with pytest.raises(ValueError, match="gauge"):
+        reg.counter("y")
+
+
+def test_snapshot_derived_histogram_key_collision_is_suffixed():
+    reg = Registry()
+    reg.counter("lap_p50").inc(7.0)           # literal name
+    h = reg.histogram("lap")                  # derives lap_p50 etc.
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["lap_p50"] == 7.0             # literal key untouched
+    assert snap["lap_p50_hist"] == 2.0        # derived key suffixed
+    assert snap["lap_p90"] == pytest.approx(2.8)
+
+
+def test_registry_histogram_honors_max_samples():
+    reg = Registry()
+    h = reg.histogram("laps", max_samples=4)
+    for v in range(100):
+        h.observe(float(v))
+    assert len(h.values) == 4 and len(h) == 100

@@ -1,0 +1,240 @@
+"""Exporter layer (tpunet/obs/export/): the non-blocking contract.
+
+The promises under test: ``write`` never blocks or raises regardless
+of endpoint state; a full queue drops AND counts; close() flushes
+in-order with a bounded timeout; and every record that enters write()
+is accounted for (enqueued == sent + send_errors + dropped) — plus the
+end-to-end smoke: records produced by a real two-step training run
+flow through an exporter to its transport.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from tpunet.config import (CheckpointConfig, DataConfig, ExportConfig,
+                           MeshConfig, ModelConfig, ObsConfig,
+                           OptimConfig, TrainConfig)
+from tpunet.obs import Registry
+from tpunet.obs.export import (AsyncExporter, HttpLineTransport,
+                               MemoryTransport, StatsdTransport,
+                               build_exporters)
+from tpunet.obs.export.statsd import record_to_lines
+
+
+def test_exporter_delivers_in_order_and_flushes_on_close():
+    transport = MemoryTransport()
+    exp = AsyncExporter(transport, name="mem")
+    for i in range(100):
+        exp.write({"kind": "obs_step", "step": i})
+    exp.close()
+    assert [r["step"] for r in transport.records] == list(range(100))
+    stats = exp.stats()
+    assert stats == {"enqueued": 100, "sent": 100,
+                     "send_errors": 0, "dropped": 0}
+
+
+def test_queue_overflow_drops_and_counts_without_blocking():
+    gate = threading.Event()                 # wedged endpoint
+    transport = MemoryTransport(gate=gate)
+    reg = Registry()
+    exp = AsyncExporter(transport, name="mem", queue_size=4,
+                        flush_timeout=2.0, registry=reg)
+    t0 = time.perf_counter()
+    for i in range(50):
+        exp.write({"step": i})
+    write_time = time.perf_counter() - t0
+    # 50 writes against a dead endpoint: pure queue puts, no waiting.
+    assert write_time < 0.5
+    # 4 queued (+possibly 1 in flight at the gate); the rest dropped.
+    assert reg.counter("export_mem_dropped").value >= 45
+    gate.set()
+    exp.close()
+    stats = exp.stats()
+    # Total accounting: every one of the 50 writes is either delivered
+    # or in the drop counter — nothing silently vanished.
+    assert stats["sent"] == stats["enqueued"]
+    assert stats["send_errors"] == 0
+    assert stats["enqueued"] + stats["dropped"] == 50
+    assert len(transport.records) == stats["sent"]
+
+
+def test_wedged_transport_flush_timeout_accounts_for_leftovers():
+    gate = threading.Event()                 # never released: hard wedge
+    transport = MemoryTransport(gate=gate)
+    reg = Registry()
+    exp = AsyncExporter(transport, name="mem", queue_size=4,
+                        flush_timeout=0.2, registry=reg)
+    for i in range(10):
+        exp.write({"step": i})
+    t0 = time.perf_counter()
+    exp.close()                              # join times out, bounded
+    assert time.perf_counter() - t0 < 2.0
+    stats = exp.stats()
+    # Nothing delivered, yet all 10 writes are in the drop counter:
+    # put_nowait overflows plus the flush-timeout leftovers.
+    assert stats["sent"] == 0 and stats["send_errors"] == 0
+    assert stats["dropped"] == 10
+    assert reg.counter("export_mem_dropped").value == 10
+    gate.set()                               # un-wedge: the abandoned
+    time.sleep(0.2)                          # thread discards the queue;
+    # at most the single in-flight send completes, and it stays
+    # accounted as dropped (over-delivery, never double-counting).
+    assert len(transport.records) <= 1
+    assert exp.stats()["sent"] == 0
+
+
+def test_flaky_transport_errors_are_counted_not_raised():
+    transport = MemoryTransport(fail_every=3)
+    reg = Registry()
+    exp = AsyncExporter(transport, name="mem", registry=reg)
+    for i in range(30):
+        exp.write({"step": i})
+    exp.close()
+    stats = exp.stats()
+    assert stats["send_errors"] == 10
+    assert stats["sent"] == 20
+    assert stats["enqueued"] == stats["sent"] + stats["send_errors"]
+    assert reg.gauge("export_mem_send_errors").value == 10
+
+
+def test_dead_http_endpoint_never_blocks_write():
+    # A port nothing listens on: connection refused on the drain
+    # thread; the training-thread side must stay O(queue put).
+    transport = HttpLineTransport("http://127.0.0.1:9/", timeout=0.2)
+    reg = Registry()
+    exp = AsyncExporter(transport, name="http", queue_size=8,
+                        flush_timeout=3.0, registry=reg)
+    t0 = time.perf_counter()
+    for i in range(200):
+        exp.write({"kind": "obs_step", "step": i})
+    assert time.perf_counter() - t0 < 0.5
+    exp.close()
+    stats = exp.stats()
+    # Nothing was ever delivered, and every one of the 200 writes is
+    # accounted for across the error and drop counters.
+    assert stats["sent"] == 0
+    assert (stats["sent"] + stats["send_errors"] + stats["dropped"]
+            == 200)
+
+
+def test_statsd_lines_and_datagram_delivery():
+    lines = record_to_lines(
+        {"kind": "obs_epoch", "epoch": 3, "mfu": 0.5,
+         "unit": "tokens", "partial": True, "device_memory": []},
+        prefix="tp")
+    assert "tp.obs_epoch.epoch:3|g" in lines
+    assert "tp.obs_epoch.mfu:0.5|g" in lines
+    # strings, bools, and nested fields never become gauges
+    assert not any("unit" in l or "partial" in l or "device_memory" in l
+                   for l in lines)
+
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5.0)
+    port = rx.getsockname()[1]
+    transport = StatsdTransport("127.0.0.1", port)
+    exp = AsyncExporter(transport, name="statsd")
+    exp.write({"kind": "obs_step", "step": 7, "step_time_s": 0.25})
+    exp.close()
+    payload = rx.recv(65536).decode()
+    rx.close()
+    assert "tpunet.obs_step.step:7|g" in payload
+    assert "tpunet.obs_step.step_time_s:0.25|g" in payload
+
+
+def test_build_exporters_validates_endpoints():
+    reg = Registry()
+    with pytest.raises(ValueError, match="HOST:PORT"):
+        build_exporters(ExportConfig(statsd="nonsense"), reg)
+    with pytest.raises(ValueError, match="http"):
+        build_exporters(ExportConfig(http="ftp://x/"), reg)
+    assert build_exporters(ExportConfig(), reg) == []
+
+
+def test_smoke_two_steps_records_flow_end_to_end(tmp_path):
+    """CI smoke: a real (CPU) training run with --obs-step-every 1
+    streams obs_step and obs_epoch records through an exporter."""
+    cfg = TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        synthetic_train_size=32, synthetic_test_size=16,
+                        seq_len=64, vocab_size=32),
+        model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                          vit_heads=4, dropout_rate=0.0, dtype="float32",
+                          vocab_size=32, max_seq_len=64),
+        optim=OptimConfig(learning_rate=3e-3),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(directory=str(tmp_path),
+                                    save_best=False, save_last=False),
+        obs=ObsConfig(step_records_every=1),
+    )
+    from tpunet.train.loop import Trainer
+    trainer = Trainer(cfg)
+    transport = MemoryTransport()
+    exp = AsyncExporter(transport, name="smoke",
+                        registry=trainer.obs.registry)
+    trainer.obs.add_sink(exp)
+    try:
+        trainer.train()                      # 2 steps (32/16)
+    finally:
+        trainer.close()
+    exp.close()
+    steps = [r for r in transport.records if r.get("kind") == "obs_step"]
+    assert [r["step"] for r in steps] == [0, 1]
+    assert all(r["step_time_s"] > 0 for r in steps)
+    epoch = [r for r in transport.records
+             if r.get("kind") == "obs_epoch"]
+    assert len(epoch) == 1 and epoch[0]["steps"] == 2
+    assert exp.stats()["dropped"] == 0
+    # ... and the same stream landed in metrics.jsonl (shared schema).
+    from tpunet.utils.logging import MetricsLogger
+    on_disk = MetricsLogger.read_records(str(tmp_path / "metrics.jsonl"))
+    assert [r for r in on_disk if r.get("kind") == "obs_step"]
+
+
+def test_batching_transport_gets_backlogs_in_order():
+    """A transport with send_many (the HTTP one) drains the queue in
+    batches — order preserved, every record counted exactly once."""
+    batches = []
+    gate = threading.Event()
+
+    class BatchProbe:
+        def send_many(self, records):
+            gate.wait()
+            batches.append(list(records))
+
+        def send(self, record):
+            self.send_many([record])
+
+    exp = AsyncExporter(BatchProbe(), name="batch", queue_size=256)
+    for i in range(100):
+        exp.write({"step": i})
+    gate.set()                                # backlog built up first
+    exp.close()
+    flat = [r["step"] for b in batches for r in b]
+    assert flat == list(range(100))
+    assert len(batches) < 100                 # actually batched
+    assert max(len(b) for b in batches) <= 64
+    assert exp.stats() == {"enqueued": 100, "sent": 100,
+                           "send_errors": 0, "dropped": 0}
+
+
+def test_exported_records_are_json_serializable():
+    """The HTTP transport json.dumps every record — the epoch record's
+    nested fields must stay plain types."""
+    sent = []
+
+    class Probe:
+        def send(self, record):
+            sent.append(json.loads(json.dumps(record)))
+
+    exp = AsyncExporter(Probe(), name="probe")
+    exp.write({"kind": "obs_epoch", "epoch": 1,
+               "device_memory": [{"device": 0, "bytes_in_use": 5}],
+               "mfu": 0.5})
+    exp.close()
+    assert sent[0]["device_memory"][0]["bytes_in_use"] == 5

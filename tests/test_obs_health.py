@@ -1,0 +1,245 @@
+"""Run-health watchdog (tpunet/obs/health.py): each detector emits an
+``obs_alert`` record, rate limiting works, ``--halt-on-unhealthy``
+raises after the record lands, and the trainer integration writes
+alerts into metrics.jsonl before any hard abort."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                           ModelConfig, ObsConfig, OptimConfig,
+                           TrainConfig)
+from tpunet.obs import MemorySink, Registry, RunUnhealthyError, Watchdog
+from tpunet.utils.logging import MetricsLogger
+
+
+def make_watchdog(expected_processes=1, clock=None, **cfg_kw):
+    cfg = ObsConfig(**cfg_kw)
+    reg = Registry()
+    sink = MemorySink()
+    reg.add_sink(sink)
+    kw = {"expected_processes": expected_processes}
+    if clock is not None:
+        kw["clock"] = clock
+    return Watchdog(cfg, reg, **kw), reg, sink
+
+
+def feed_baseline(wd, n=16, lap=0.01):
+    for i in range(n):
+        wd.observe_step(i, lap)
+
+
+def test_step_stall_alert_with_detail():
+    wd, reg, sink = make_watchdog(stall_factor=10.0, stall_min_s=0.0)
+    feed_baseline(wd)
+    wd.observe_step(16, 0.5)                 # 50x the 10ms baseline
+    alerts = sink.by_kind("obs_alert")
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["reason"] == "step_stall" and a["step"] == 16
+    assert a["severity"] == "fatal"
+    assert a["step_time_s"] == 0.5
+    assert a["baseline_p50_s"] == pytest.approx(0.01)
+    assert reg.counter("obs_alerts").value == 1
+
+
+def test_stall_needs_absolute_floor():
+    # 50x a microsecond baseline is still microseconds — not a page.
+    wd, _, sink = make_watchdog(stall_factor=10.0, stall_min_s=1.0)
+    feed_baseline(wd, lap=1e-5)
+    wd.observe_step(16, 5e-4)
+    assert sink.by_kind("obs_alert") == []
+
+
+def test_no_stall_verdict_before_baseline_warmup():
+    wd, _, sink = make_watchdog(stall_factor=2.0, stall_min_s=0.0)
+    wd.observe_step(0, 0.01)
+    wd.observe_step(1, 10.0)                 # compile-step blip
+    assert sink.by_kind("obs_alert") == []
+
+
+def test_alert_cooldown_suppresses_repeats_but_counts_them():
+    wd, reg, sink = make_watchdog(stall_factor=10.0, stall_min_s=0.0,
+                                  alert_cooldown_steps=50)
+    feed_baseline(wd)
+    for step in range(16, 26):
+        wd.observe_step(step, 0.5)
+    assert len(sink.by_kind("obs_alert")) == 1
+    assert reg.counter("obs_alerts_suppressed").value == 9
+    # ... and a later recurrence past the cooldown fires again
+    wd.observe_step(80, 0.5)
+    assert len(sink.by_kind("obs_alert")) == 2
+
+
+def test_nan_and_inf_loss_alert():
+    wd, _, sink = make_watchdog()
+    wd.observe_loss(5, float("nan"))
+    wd.observe_loss(60, float("inf"))
+    alerts = sink.by_kind("obs_alert")
+    assert [a["reason"] for a in alerts] == ["nan_loss", "nan_loss"]
+
+
+def test_loss_spike_alert_after_warmup():
+    wd, _, sink = make_watchdog(loss_spike_factor=5.0)
+    for i in range(6):
+        wd.observe_loss(i, 2.0)
+    wd.observe_loss(6, 50.0)                 # 25x the EMA
+    alerts = sink.by_kind("obs_alert")
+    assert len(alerts) == 1 and alerts[0]["reason"] == "loss_spike"
+    # warmup: the same spike in the first observations never fires
+    wd2, _, sink2 = make_watchdog(loss_spike_factor=5.0)
+    wd2.observe_loss(0, 2.0)
+    wd2.observe_loss(1, 50.0)
+    assert sink2.by_kind("obs_alert") == []
+
+
+def test_stale_heartbeat_uses_injected_clock():
+    now = [0.0]
+    wd, _, sink = make_watchdog(heartbeat_timeout_s=30.0,
+                                clock=lambda: now[0])
+    wd.observe_heartbeat(live=1, step=0)
+    now[0] = 10.0
+    wd.check_heartbeat(step=5)
+    assert sink.by_kind("obs_alert") == []
+    wd.observe_heartbeat(live=1, step=5)     # fresh beat at t=10
+    now[0] = 45.0                            # 35s since the last beat
+    wd.check_heartbeat(step=9)
+    alerts = sink.by_kind("obs_alert")
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["reason"] == "stale_heartbeat" and a["severity"] == "warn"
+    assert a["age_s"] == pytest.approx(35.0)
+
+
+def test_missing_processes_alert():
+    wd, _, sink = make_watchdog(expected_processes=4)
+    wd.observe_heartbeat(live=3, step=100)
+    alerts = sink.by_kind("obs_alert")
+    assert len(alerts) == 1
+    assert alerts[0]["reason"] == "missing_processes"
+    assert alerts[0]["live"] == 3 and alerts[0]["expected"] == 4
+
+
+def test_halt_on_unhealthy_raises_after_emitting():
+    wd, _, sink = make_watchdog(halt_on_unhealthy=True)
+    with pytest.raises(RunUnhealthyError, match="nan_loss"):
+        wd.observe_loss(7, float("nan"))
+    # the record landed BEFORE the raise: post-mortems explain themselves
+    assert sink.by_kind("obs_alert")[0]["reason"] == "nan_loss"
+
+
+def test_halt_routes_through_on_fatal_when_set():
+    """Multi-host shape: a fatal alert must not raise on one process
+    (the others would wedge in their next collective) — with on_fatal
+    set, the watchdog invokes it (the trainer wires it to the
+    cross-host-agreed preemption stop) instead of raising."""
+    wd, _, sink = make_watchdog(halt_on_unhealthy=True)
+    halts = []
+    wd.on_fatal = halts.append
+    wd.observe_loss(7, float("nan"))         # no raise
+    assert len(halts) == 1 and halts[0]["reason"] == "nan_loss"
+    assert sink.by_kind("obs_alert")[0]["reason"] == "nan_loss"
+
+
+def test_monitor_thread_pages_on_a_wedged_run():
+    """The per-step checks cannot fire when the training thread is
+    stuck inside a step — the background monitor emits the
+    stale_heartbeat alert anyway (and exactly once, via the cooldown
+    on the frozen step counter)."""
+    import time as _time
+    wd, _, sink = make_watchdog(heartbeat_timeout_s=0.3)
+    wd.start_monitor()
+    try:
+        _time.sleep(1.0)                     # no progress at all
+    finally:
+        wd.stop_monitor()
+    alerts = sink.by_kind("obs_alert")
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["reason"] == "stale_heartbeat" and a["source"] == "monitor"
+    assert a["severity"] == "warn"
+
+
+def test_monitor_not_started_without_timeout():
+    wd, _, _ = make_watchdog()               # heartbeat_timeout_s == 0
+    wd.start_monitor()
+    assert wd._monitor is None
+
+
+# ---------------------------------------------------------------------------
+# trainer integration
+# ---------------------------------------------------------------------------
+
+
+def _cfg(tmp_path, **obs_kw):
+    return TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        synthetic_train_size=64, synthetic_test_size=16,
+                        seq_len=64, vocab_size=32),
+        model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                          vit_heads=4, dropout_rate=0.0, dtype="float32",
+                          vocab_size=32, max_seq_len=64),
+        optim=OptimConfig(learning_rate=3e-3),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(directory=str(tmp_path),
+                                    save_best=False, save_last=False),
+        obs=ObsConfig(**obs_kw),
+    )
+
+
+def _poison(trainer):
+    trainer.state = trainer.state.replace(
+        params=jax.tree_util.tree_map(
+            lambda p: p * jnp.nan, trainer.state.params))
+
+
+def test_nan_run_writes_obs_alert_before_hard_abort(tmp_path):
+    from tpunet.train.loop import Trainer
+    trainer = Trainer(_cfg(tmp_path))
+    _poison(trainer)
+    try:
+        with pytest.raises(FloatingPointError):
+            trainer.train()
+    finally:
+        trainer.close()
+    records = MetricsLogger.read_records(str(tmp_path / "metrics.jsonl"))
+    alerts = [r for r in records if r.get("kind") == "obs_alert"]
+    assert alerts and alerts[0]["reason"] == "nan_loss"
+
+
+def test_halt_on_unhealthy_aborts_the_run(tmp_path):
+    from tpunet.train.loop import Trainer
+    trainer = Trainer(_cfg(tmp_path, halt_on_unhealthy=True))
+    _poison(trainer)
+    try:
+        with pytest.raises(RunUnhealthyError, match="nan_loss"):
+            trainer.train()
+    finally:
+        trainer.close()
+    records = MetricsLogger.read_records(str(tmp_path / "metrics.jsonl"))
+    assert [r for r in records if r.get("kind") == "obs_alert"]
+
+
+def test_watchdog_disabled_with_obs(tmp_path):
+    from tpunet.train.loop import Trainer
+    trainer = Trainer(_cfg(tmp_path, enabled=False))
+    try:
+        assert trainer.obs.watchdog is None
+        trainer.obs.observe_loss(0, float("nan"))   # no-op, no crash
+    finally:
+        trainer.close()
+
+
+def test_watchdog_default_run_stays_quiet(tmp_path):
+    """A healthy run emits zero alerts at default thresholds (no
+    false pages from ordinary CPU-step jitter)."""
+    from tpunet.train.loop import Trainer
+    trainer = Trainer(_cfg(tmp_path))
+    try:
+        trainer.train()
+    finally:
+        trainer.close()
+    records = MetricsLogger.read_records(str(tmp_path / "metrics.jsonl"))
+    assert not [r for r in records if r.get("kind") == "obs_alert"]

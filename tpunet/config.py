@@ -250,6 +250,25 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class ExportConfig:
+    """Live telemetry export (tpunet/obs/export/): push finished obs
+    records to off-host endpoints through a bounded queue drained by a
+    background thread — a dead endpoint can never stall a step; full
+    queues drop and count (``export_*_dropped``). Coordinator-only,
+    like the metrics.jsonl writes."""
+
+    statsd: str = ""                  # "HOST:PORT" UDP statsd endpoint
+    statsd_prefix: str = "tpunet"
+    http: str = ""                    # line-JSON POST URL
+    # Bounded export queue: put_nowait from the step path; overflow
+    # drops (counted) rather than blocking.
+    queue_size: int = 1024
+    # close() flush budget and the per-request HTTP socket timeout.
+    flush_timeout_s: float = 5.0
+    http_timeout_s: float = 1.0
+
+
+@dataclass(frozen=True)
 class ObsConfig:
     """Step-level observability (tpunet/obs/): per-step timing
     histograms, throughput/MFU and input-stall accounting, epoch-
@@ -272,6 +291,29 @@ class ObsConfig:
     # <checkpoint-dir>/profile.
     profile_start_step: int = 0
     profile_num_steps: int = 0
+    # Histogram memory bound: windows beyond this many observations
+    # switch from exact percentiles to seeded reservoir sampling
+    # (count/mean stay exact; the summary carries ``approx: 1``).
+    histogram_max_samples: int = 65536
+    # -- run-health watchdog (tpunet/obs/health.py) -----------------
+    # A step slower than stall_factor x the rolling median (and at
+    # least stall_min_s) emits a step_stall obs_alert. 0 disables.
+    stall_factor: float = 10.0
+    stall_min_s: float = 1.0
+    # A host-available loss above loss_spike_factor x its warmed-up
+    # EMA emits a loss_spike alert (non-finite always alerts). 0
+    # disables spike detection.
+    loss_spike_factor: float = 5.0
+    # No heartbeat for this long emits stale_heartbeat; 0 (default)
+    # disables — epoch length varies too much for a universal budget.
+    heartbeat_timeout_s: float = 0.0
+    # Same-reason alerts within this many steps are suppressed
+    # (counted in obs_alerts_suppressed) so a stall pages once.
+    alert_cooldown_steps: int = 50
+    # Fatal alerts raise RunUnhealthyError instead of just recording:
+    # the --halt-on-unhealthy knob, for runs nobody is watching.
+    halt_on_unhealthy: bool = False
+    export: ExportConfig = field(default_factory=ExportConfig)
 
 
 @dataclass(frozen=True)
@@ -496,6 +538,36 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--obs-step-every", type=int, default=None,
                    help="emit a per-step obs_step record every N "
                         "steps (0 = per-epoch obs records only)")
+    p.add_argument("--statsd", default=None, metavar="HOST:PORT",
+                   help="stream obs records as statsd/UDP gauges to "
+                        "this endpoint (non-blocking: bounded queue + "
+                        "background sender; drops are counted)")
+    p.add_argument("--obs-http", default=None, metavar="URL",
+                   help="POST obs records as line-JSON to this URL "
+                        "(same non-blocking queue; pair with "
+                        "'scripts/obs_dashboard.py --listen PORT')")
+    p.add_argument("--obs-queue-size", type=int, default=None,
+                   help="bounded export queue depth (overflow drops "
+                        "records and counts them, never blocks a step)")
+    p.add_argument("--halt-on-unhealthy", action="store_true",
+                   help="abort the run (RunUnhealthyError) on a fatal "
+                        "obs_alert: step stall, NaN/spiking loss, or "
+                        "missing processes — after the alert record "
+                        "is written")
+    p.add_argument("--stall-factor", type=float, default=None,
+                   help="step_stall alert threshold: a step slower "
+                        "than FACTOR x the rolling median (and at "
+                        "least --stall-min-s); 0 disables")
+    p.add_argument("--stall-min-s", type=float, default=None,
+                   help="absolute floor (seconds) a step must exceed "
+                        "to count as stalled")
+    p.add_argument("--loss-spike-factor", type=float, default=None,
+                   help="loss_spike alert threshold: loss above "
+                        "FACTOR x its EMA; 0 disables")
+    p.add_argument("--heartbeat-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="stale_heartbeat alert when no epoch "
+                        "heartbeat lands for this long (0 = off)")
     p.add_argument("--log-every-steps", type=int, default=None,
                    help="emit a step/loss/lr line every N steps (0 = "
                         "per-epoch only, like the reference)")
@@ -530,6 +602,25 @@ def config_from_args(argv=None) -> TrainConfig:
     if args.profile_num_steps is not None:
         obs = dataclasses.replace(obs,
                                   profile_num_steps=args.profile_num_steps)
+    export = obs.export
+    if args.statsd is not None:
+        export = dataclasses.replace(export, statsd=args.statsd)
+    if args.obs_http is not None:
+        export = dataclasses.replace(export, http=args.obs_http)
+    if args.obs_queue_size is not None:
+        export = dataclasses.replace(export,
+                                     queue_size=args.obs_queue_size)
+    if export is not obs.export:
+        obs = dataclasses.replace(obs, export=export)
+    if args.halt_on_unhealthy:
+        obs = dataclasses.replace(obs, halt_on_unhealthy=True)
+    for obs_field, arg in (("stall_factor", args.stall_factor),
+                           ("stall_min_s", args.stall_min_s),
+                           ("loss_spike_factor", args.loss_spike_factor),
+                           ("heartbeat_timeout_s",
+                            args.heartbeat_timeout)):
+        if arg is not None:
+            obs = dataclasses.replace(obs, **{obs_field: arg})
     if args.batch_size is not None:
         data = dataclasses.replace(data, batch_size=args.batch_size)
     if args.image_size is not None:

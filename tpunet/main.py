@@ -20,6 +20,7 @@ import dataclasses
 import jax
 
 from tpunet.config import config_from_args
+from tpunet.obs import RunUnhealthyError
 from tpunet.parallel import initialize_distributed, sync_hosts
 from tpunet.train.loop import Trainer
 from tpunet.utils import log0
@@ -58,6 +59,12 @@ def main(argv=None) -> int:
                  f"Test Acc: {m['accuracy']:.4f}")
         else:
             trainer.train()
+    except RunUnhealthyError as e:
+        # --halt-on-unhealthy tripped: the obs_alert record is already
+        # in metrics.jsonl (and the live exporters) — exit nonzero
+        # without a traceback, like a failed health check should.
+        log0(f"ABORT: {e}")
+        return 2
     finally:
         # Runs on the NaN-guard/preemption-raise paths too; close()
         # flushes checkpoints AND any still-open profiler trace, each

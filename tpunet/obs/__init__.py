@@ -12,6 +12,15 @@ One ``Observability`` object per run orchestrates the pieces:
 - ``perf``       — analytic model FLOPs -> MFU, device peak lookup.
 - ``memory``     — per-device ``memory_stats()`` gauges and the
   coordinator-side multi-host heartbeat, sampled at epoch boundaries.
+- ``export``     — live off-host telemetry (StatsD/UDP, line-JSON
+  HTTP) behind a bounded queue + drain thread: a dead endpoint costs
+  the step path one ``put_nowait``, never a stall; overflow drops are
+  counted, never silent.
+- ``health``     — run-health watchdog over the same record stream:
+  step stalls, NaN/spiking loss, stale heartbeats -> ``obs_alert``
+  records, optionally aborting the run (``--halt-on-unhealthy``).
+- ``summary``    — the one summarizer ``scripts/obs_report.py`` and
+  ``scripts/obs_dashboard.py`` share.
 
 Clock discipline: all timing is ``time.perf_counter`` (monotonic);
 jax dispatch is async, so per-step wall time is the host-side lap
@@ -34,14 +43,15 @@ from typing import Optional
 
 from tpunet.obs import memory as obs_memory
 from tpunet.obs import perf
+from tpunet.obs.health import RunUnhealthyError, Watchdog
 from tpunet.obs.registry import (Counter, Gauge, Histogram, JsonlSink,
                                  MemorySink, Registry)
 from tpunet.obs.spans import NULL_SPAN, WindowedProfiler, span, step_span
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "JsonlSink", "MemorySink",
-    "NULL_SPAN", "Observability", "Registry", "WindowedProfiler",
-    "perf", "span", "step_span",
+    "NULL_SPAN", "Observability", "Registry", "RunUnhealthyError",
+    "Watchdog", "WindowedProfiler", "perf", "span", "step_span",
 ]
 
 
@@ -65,6 +75,32 @@ class Observability:
         self.unit = unit
         self.step_records_every = cfg.step_records_every
         self.registry = Registry()
+        self._hist_max = getattr(cfg, "histogram_max_samples",
+                                 Histogram.DEFAULT_MAX_SAMPLES)
+        # Run-health watchdog: consumes the same host-side laps/losses
+        # this facade already sees, emits obs_alert records through
+        # the registry (so they reach metrics.jsonl and every live
+        # exporter), and raises RunUnhealthyError when
+        # --halt-on-unhealthy is set. None when obs is disabled.
+        self.watchdog = None
+        if self.enabled:
+            import jax
+            self.watchdog = Watchdog(
+                cfg, self.registry,
+                expected_processes=jax.process_count())
+            # Emit-only wedge detector (no-op unless a heartbeat
+            # budget is configured): pages through the live exporters
+            # even when the training thread is stuck inside a step.
+            self.watchdog.start_monitor()
+        # Live exporters (statsd / line-JSON HTTP): non-blocking
+        # bounded-queue sinks, coordinator-only; empty list unless
+        # endpoints are configured. Flushed in close().
+        self._exporters = []
+        if self.enabled and getattr(cfg, "export", None) is not None:
+            from tpunet.obs.export import build_exporters
+            self._exporters = build_exporters(cfg.export, self.registry)
+            for exporter in self._exporters:
+                self.registry.add_sink(exporter)
         if ((cfg.profile_num_steps or cfg.profile_start_step)
                 and not profile_dir):
             # A window knob without --profile-dir lands next to the
@@ -113,10 +149,13 @@ class Observability:
             self.profiler.on_step(step, sync)
 
     def observe_step(self, step: int, seconds: float) -> None:
-        """One finished step's host lap (dispatch-side wall time)."""
+        """One finished step's host lap (dispatch-side wall time).
+        Feeds the watchdog's stall detector, which may raise
+        ``RunUnhealthyError`` under ``--halt-on-unhealthy``."""
         if not self.enabled:
             return
-        self.registry.histogram("step_time_s").observe(seconds)
+        self.registry.histogram(
+            "step_time_s", max_samples=self._hist_max).observe(seconds)
         every = self.step_records_every
         if every and step % every == 0:
             self.registry.emit("obs_step", {
@@ -124,6 +163,15 @@ class Observability:
                 "step_time_s": round(seconds, 6),
                 "data_wait_s": round(self._last_wait, 6),
             })
+        if self.watchdog is not None:
+            self.watchdog.observe_step(step, seconds)
+
+    def observe_loss(self, step: int, loss: float) -> None:
+        """A loss value that is ALREADY a host float (the step-log
+        line or the epoch summary) — the watchdog's NaN/spike checks
+        never force a device sync of their own."""
+        if self.watchdog is not None:
+            self.watchdog.observe_loss(step, loss)
 
     def observe_data_wait(self, seconds: float) -> None:
         """Host time spent blocked on the input pipeline for one batch
@@ -132,7 +180,8 @@ class Observability:
         if not self.enabled:
             return
         self._last_wait = seconds
-        self.registry.histogram("data_wait_s").observe(seconds)
+        self.registry.histogram(
+            "data_wait_s", max_samples=self._hist_max).observe(seconds)
 
     # -- epoch window ----------------------------------------------------
 
@@ -159,6 +208,11 @@ class Observability:
         mem = obs_memory.sample_memory_gauges(reg)
         live = obs_memory.heartbeat(
             reg, time.perf_counter() - self._run_start)
+        if self.watchdog is not None:
+            # Feed the liveness result BEFORE emitting the epoch
+            # record: a missing_processes alert then precedes the
+            # epoch row it explains in metrics.jsonl.
+            self.watchdog.observe_heartbeat(live, step=step)
         record = {
             "epoch": epoch,
             "step": step,
@@ -171,6 +225,7 @@ class Observability:
             "step_time_p50_s": steps.get("p50"),
             "step_time_p90_s": steps.get("p90"),
             "step_time_p99_s": steps.get("p99"),
+            **({"step_time_approx": 1} if steps.get("approx") else {}),
             "input_stall_s": round(wait_total, 4),
             "stall_frac": round(wait_total / busy, 4) if busy > 0 else 0.0,
             "device_memory": mem,
@@ -192,6 +247,18 @@ class Observability:
     # -- lifecycle -------------------------------------------------------
 
     def close(self, sync=None) -> None:
-        """Flush a still-open profile window (end of run / error
-        path)."""
-        self.profiler.close(sync)
+        """Flush a still-open profile window and drain the export
+        queues (end of run / error path). Exporter close is bounded by
+        the configured flush timeout, so a dead endpoint cannot wedge
+        shutdown."""
+        try:
+            self.profiler.close(sync)
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.stop_monitor()
+            for exporter in self._exporters:
+                try:
+                    exporter.close()
+                except Exception:
+                    pass
+            self._exporters = []

@@ -1,0 +1,212 @@
+"""Run-health watchdog: turn the obs record stream into pages.
+
+The watchdog rides the same host-side observations the registry
+already collects — no extra device syncs, no new collectives — and
+emits ``obs_alert`` records (through ``Registry.emit``, so they reach
+metrics.jsonl AND every live exporter) when a run goes bad in one of
+the ways that actually burn walltime:
+
+- **step stall**: a step takes ``stall_factor``x the rolling median of
+  recent steps (and at least ``stall_min_s`` — compile-scale blips on
+  millisecond steps are not incidents).
+- **nan loss / loss spike**: a non-finite loss, or a loss above
+  ``loss_spike_factor``x its warmed-up EMA (the divergence shape that
+  precedes NaN by a few hundred steps).
+- **stale heartbeat / missing processes**: no heartbeat inside
+  ``heartbeat_timeout_s`` (a wedged epoch), or an epoch heartbeat
+  counting fewer live processes than the pod started with.
+
+Alerts are per-reason rate-limited (``alert_cooldown_steps``) so a
+stalled input pipeline pages once, not once per step; suppressed
+repeats still count (``obs_alerts_suppressed``). With
+``halt_on_unhealthy`` a fatal alert raises ``RunUnhealthyError`` after
+the record is emitted — the record always lands first, so the
+post-mortem shows *why* the run stopped.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class RunUnhealthyError(RuntimeError):
+    """Raised by the watchdog under ``--halt-on-unhealthy`` after the
+    corresponding ``obs_alert`` record has been emitted."""
+
+
+class Watchdog:
+    # Steps of step-time history backing the rolling median baseline.
+    WINDOW = 64
+    # Baseline warmup: no stall verdicts until this many steps seen
+    # (the first steps include compile time and are not a baseline).
+    MIN_BASELINE = 8
+    # Loss-EMA warmup before spike verdicts, and its decay.
+    MIN_LOSS_OBS = 5
+    LOSS_EMA_DECAY = 0.9
+
+    def __init__(self, cfg, registry, *, expected_processes: int = 1,
+                 clock=time.monotonic):
+        self.cfg = cfg
+        self.registry = registry
+        self.expected_processes = expected_processes
+        # Multi-host halt hook: raising RunUnhealthyError on ONE
+        # process of a pod would wedge the others in their next
+        # collective, so the trainer sets this to the preemption
+        # guard's request() — the existing cross-host-agreed stop then
+        # halts every process at a step boundary. When unset
+        # (single-process), a fatal alert raises directly.
+        self.on_fatal = None
+        self._clock = clock
+        self._laps: deque = deque(maxlen=self.WINDOW)
+        self._loss_ema: Optional[float] = None
+        self._loss_obs = 0
+        self._last_beat = clock()
+        self._last_progress = clock()
+        self._last_step = 0
+        self._last_alert_step: dict = {}
+        self._monitor: Optional[threading.Thread] = None
+        self._stop_monitor = threading.Event()
+        self.alerts: list = []
+
+    # -- observations ----------------------------------------------------
+
+    def observe_step(self, step: int, seconds: float) -> None:
+        """One finished step's host lap. Checks the stall predicate
+        against the pre-existing baseline, then folds the lap in (a
+        median baseline is robust to the stalled samples landing in
+        the window), then piggybacks the heartbeat-staleness check —
+        the step loop is the only reliable periodic pulse we have."""
+        cfg = self.cfg
+        if (len(self._laps) >= self.MIN_BASELINE
+                and cfg.stall_factor > 0):
+            baseline = sorted(self._laps)[len(self._laps) // 2]
+            threshold = max(baseline * cfg.stall_factor, cfg.stall_min_s)
+            if seconds > threshold:
+                self._alert("step_stall", step, fatal=True, detail={
+                    "step_time_s": round(seconds, 4),
+                    "baseline_p50_s": round(baseline, 4),
+                    "threshold_s": round(threshold, 4),
+                })
+        self._laps.append(seconds)
+        self._last_progress = self._clock()
+        self._last_step = step
+        self.check_heartbeat(step=step)
+
+    def observe_loss(self, step: int, loss: float) -> None:
+        """A host-available loss value (the per-step log line or the
+        epoch summary — the watchdog never forces a device sync to get
+        one)."""
+        if not math.isfinite(loss):
+            self._alert("nan_loss", step, fatal=True,
+                        detail={"loss": str(loss)})
+            return
+        spike = self.cfg.loss_spike_factor
+        if (spike > 0 and self._loss_ema is not None
+                and self._loss_obs >= self.MIN_LOSS_OBS
+                and loss > spike * self._loss_ema):
+            self._alert("loss_spike", step, fatal=True, detail={
+                "loss": round(loss, 6),
+                "ema": round(self._loss_ema, 6),
+                "factor": spike,
+            })
+        d = self.LOSS_EMA_DECAY
+        self._loss_ema = (loss if self._loss_ema is None
+                          else d * self._loss_ema + (1.0 - d) * loss)
+        self._loss_obs += 1
+
+    def observe_heartbeat(self, live: int, step: int = 0) -> None:
+        """An epoch-boundary heartbeat: ``live`` processes answered
+        the allgather."""
+        self._last_beat = self._clock()
+        if live < self.expected_processes:
+            self._alert("missing_processes", step, fatal=True, detail={
+                "live": live, "expected": self.expected_processes})
+
+    def check_heartbeat(self, step: int = 0) -> None:
+        """Stale-heartbeat predicate: too long since the last epoch
+        heartbeat. Off by default (``heartbeat_timeout_s == 0``) —
+        epoch length varies by orders of magnitude across configs, so
+        the operator sets the budget."""
+        timeout = self.cfg.heartbeat_timeout_s
+        if timeout <= 0:
+            return
+        age = self._clock() - self._last_beat
+        if age > timeout:
+            self._last_beat = self._clock()  # re-arm, don't re-fire per step
+            self._alert("stale_heartbeat", step, fatal=False, detail={
+                "age_s": round(age, 2), "timeout_s": timeout})
+
+    # -- wedge monitor ---------------------------------------------------
+
+    def start_monitor(self) -> None:
+        """Background wedge detector (``heartbeat_timeout_s > 0``
+        only): the per-step checks above can never fire when the
+        training thread is stuck *inside* a step (the canonical dead-
+        collective failure) — this daemon thread watches for the
+        absence of any progress and emits a ``stale_heartbeat`` alert
+        that still reaches the live exporters, so the operator gets
+        paged even though the process itself is wedged. Emit-only: it
+        never raises or requests a halt (the training thread may be
+        beyond saving, and the alert is the point)."""
+        if self._monitor is not None or self.cfg.heartbeat_timeout_s <= 0:
+            return
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="tpunet-watchdog",
+            daemon=True)
+        self._monitor.start()
+
+    def stop_monitor(self) -> None:
+        if self._monitor is None:
+            return
+        self._stop_monitor.set()
+        self._monitor.join(timeout=2.0)
+        self._monitor = None
+
+    def _monitor_loop(self) -> None:
+        timeout = self.cfg.heartbeat_timeout_s
+        poll = min(max(timeout / 4.0, 0.5), 5.0)
+        while not self._stop_monitor.wait(poll):
+            age = self._clock() - max(self._last_beat,
+                                      self._last_progress)
+            if age > timeout:
+                # The step counter is frozen while wedged, so the
+                # per-reason cooldown keyed on it fires exactly once.
+                self._alert("stale_heartbeat", self._last_step,
+                            fatal=False, detail={
+                                "age_s": round(age, 2),
+                                "timeout_s": timeout,
+                                "source": "monitor"})
+
+    # -- alert emission --------------------------------------------------
+
+    def _alert(self, reason: str, step: int, *, fatal: bool,
+               detail: dict) -> None:
+        last = self._last_alert_step.get(reason)
+        cooldown = self.cfg.alert_cooldown_steps
+        if (last is not None and cooldown > 0 and step - last < cooldown):
+            # Uniform suppression, fatal included: on the raising path
+            # the first alert already ended the run, and on the
+            # on_fatal path the stop agreement takes up to
+            # STOP_POLL_STEPS steps to land — re-paging every stalled
+            # step in between is exactly what the cooldown exists to
+            # prevent (guard.request is idempotent, one call suffices).
+            self.registry.counter("obs_alerts_suppressed").inc()
+            return
+        self._last_alert_step[reason] = step
+        self.registry.counter("obs_alerts").inc()
+        record = {"reason": reason, "step": step,
+                  "severity": "fatal" if fatal else "warn"}
+        record.update(detail)
+        self.alerts.append(record)
+        self.registry.emit("obs_alert", record)
+        if self.cfg.halt_on_unhealthy and fatal:
+            if self.on_fatal is not None:
+                self.on_fatal(record)
+                return
+            raise RunUnhealthyError(
+                f"run unhealthy: {reason} at step {step} ({detail}); "
+                "--halt-on-unhealthy is set")

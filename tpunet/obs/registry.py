@@ -11,8 +11,23 @@ per-step sampling).
 
 from __future__ import annotations
 
-import math
+import random
 from typing import Dict, List, Optional
+
+
+def percentile_of_sorted(xs: List[float], q: float) -> float:
+    """Linear-interpolated q-th percentile (q in [0, 100]) of an
+    already-sorted non-empty list. THE percentile definition for the
+    whole obs subsystem — Histogram summaries and the
+    summary/dashboard/report pipeline all call this one function, so
+    live views can never drift from the trainer's emitted records."""
+    if len(xs) == 1:
+        return xs[0]
+    rank = (q / 100.0) * (len(xs) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = rank - lo
+    return xs[lo] * (1.0 - frac) + xs[hi] * frac
 
 
 class Counter:
@@ -41,39 +56,60 @@ class Gauge:
 
 
 class Histogram:
-    """Windowed distribution with exact percentiles.
+    """Windowed distribution with bounded memory.
 
     Observations accumulate in a list until ``reset()`` (one window ==
     one epoch in the trainer); percentiles sort a copy on demand, so
     ``observe`` is a single append — cheap enough for the per-step
-    path.
+    path. Up to ``max_samples`` observations the window is stored
+    exactly (exact percentiles); beyond it, reservoir sampling
+    (Vitter's Algorithm R, seeded so runs are reproducible) keeps a
+    uniform sample of the window and percentiles become approximate —
+    ``count`` and ``total`` stay exact either way. The default bound
+    holds a long epoch of float laps in ~0.5 MB.
     """
 
-    __slots__ = ("values",)
+    __slots__ = ("values", "max_samples", "_count", "_total", "_rng")
 
-    def __init__(self):
+    DEFAULT_MAX_SAMPLES = 65536
+
+    def __init__(self, max_samples: int = DEFAULT_MAX_SAMPLES):
+        if max_samples < 1:
+            raise ValueError(f"max_samples must be >= 1, got {max_samples}")
         self.values: List[float] = []
+        self.max_samples = max_samples
+        self._count = 0
+        self._total = 0.0
+        self._rng = random.Random(0x0B5)
 
     def observe(self, value: float) -> None:
-        self.values.append(float(value))
+        value = float(value)
+        self._count += 1
+        self._total += value
+        if len(self.values) < self.max_samples:
+            self.values.append(value)
+            return
+        # Reservoir (Algorithm R): keep each of the n seen so far with
+        # probability max_samples/n — percentiles degrade to a uniform
+        # sample of the window instead of the list growing unboundedly.
+        j = self._rng.randrange(self._count)
+        if j < self.max_samples:
+            self.values[j] = value
 
     def __len__(self) -> int:
-        return len(self.values)
+        return self._count
+
+    @property
+    def saturated(self) -> bool:
+        """True once the window overflowed the exact bound (percentiles
+        are reservoir approximations from here on)."""
+        return self._count > self.max_samples
 
     @property
     def total(self) -> float:
-        return math.fsum(self.values)
+        return self._total
 
-    @staticmethod
-    def _interp(xs: List[float], q: float) -> float:
-        """q-th percentile of an already-sorted non-empty list."""
-        if len(xs) == 1:
-            return xs[0]
-        rank = (q / 100.0) * (len(xs) - 1)
-        lo = int(rank)
-        hi = min(lo + 1, len(xs) - 1)
-        frac = rank - lo
-        return xs[lo] * (1.0 - frac) + xs[hi] * frac
+    _interp = staticmethod(percentile_of_sorted)
 
     def percentile(self, q: float) -> Optional[float]:
         """Linear-interpolated q-th percentile (q in [0, 100]); None on
@@ -85,20 +121,27 @@ class Histogram:
     def summary(self) -> Dict[str, float]:
         """{count, mean, p50, p90, p99} of the current window (empty
         dict on an empty window); one sort serves all three
-        percentiles."""
+        percentiles. ``count``/``mean`` are exact even when the window
+        saturated the reservoir (percentiles are then approximate, and
+        the summary says so with ``approx: 1``)."""
         if not self.values:
             return {}
         xs = sorted(self.values)
-        return {
-            "count": len(xs),
-            "mean": math.fsum(xs) / len(xs),
+        out = {
+            "count": self._count,
+            "mean": self._total / self._count,
             "p50": self._interp(xs, 50),
             "p90": self._interp(xs, 90),
             "p99": self._interp(xs, 99),
         }
+        if self.saturated:
+            out["approx"] = 1
+        return out
 
     def reset(self) -> None:
         self.values = []
+        self._count = 0
+        self._total = 0.0
 
 
 class MemorySink:
@@ -136,14 +179,36 @@ class Registry:
         self._histograms: Dict[str, Histogram] = {}
         self._sinks: list = []
 
+    def _claim(self, name: str, family: Dict) -> None:
+        """One name, one instrument family: a counter and a gauge
+        sharing a name used to collide silently in ``snapshot()``
+        (last writer won); refuse at creation instead."""
+        for other in (self._counters, self._gauges, self._histograms):
+            if other is not family and name in other:
+                kind = {id(self._counters): "counter",
+                        id(self._gauges): "gauge",
+                        id(self._histograms): "histogram"}[id(other)]
+                raise ValueError(
+                    f"instrument name {name!r} already registered as a "
+                    f"{kind}; one name maps to one snapshot() key")
+
     def counter(self, name: str) -> Counter:
+        if name not in self._counters:
+            self._claim(name, self._counters)
         return self._counters.setdefault(name, Counter())
 
     def gauge(self, name: str) -> Gauge:
+        if name not in self._gauges:
+            self._claim(name, self._gauges)
         return self._gauges.setdefault(name, Gauge())
 
-    def histogram(self, name: str) -> Histogram:
-        return self._histograms.setdefault(name, Histogram())
+    def histogram(self, name: str,
+                  max_samples: int = Histogram.DEFAULT_MAX_SAMPLES
+                  ) -> Histogram:
+        if name not in self._histograms:
+            self._claim(name, self._histograms)
+            self._histograms[name] = Histogram(max_samples)
+        return self._histograms[name]
 
     def add_sink(self, sink) -> None:
         self._sinks.append(sink)
@@ -157,7 +222,11 @@ class Registry:
 
     def snapshot(self) -> Dict[str, float]:
         """Flat {name: value} view of every instrument: counters and
-        gauges by name, histograms as ``name_p50`` etc."""
+        gauges by name, histograms as ``name_p50`` etc. Cross-family
+        duplicates are refused at creation; the one collision class
+        left — a derived histogram key (``lap_p50``) matching a literal
+        counter/gauge name — is disambiguated by suffixing the derived
+        key with ``_hist`` instead of silently overwriting."""
         out: Dict[str, float] = {}
         for name, c in self._counters.items():
             out[name] = c.value
@@ -166,7 +235,10 @@ class Registry:
                 out[name] = g.value
         for name, h in self._histograms.items():
             for k, v in h.summary().items():
-                out[f"{name}_{k}"] = v
+                key = f"{name}_{k}"
+                while key in out:
+                    key += "_hist"
+                out[key] = v
         return out
 
     def reset_window(self) -> None:

@@ -21,7 +21,7 @@ from tpunet.ckpt import Checkpointer
 from tpunet.config import TrainConfig
 from tpunet.data import (eval_batches, get_dataset, steps_per_epoch,
                          timed_batches, train_batches)
-from tpunet.obs import JsonlSink, Observability
+from tpunet.obs import JsonlSink, Observability, RunUnhealthyError
 from tpunet.obs.perf import train_flops_per_unit
 from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
                              shard_host_batch)
@@ -214,6 +214,23 @@ class Trainer:
             cfg.model, cfg.data, n_params=num_params(state.params)))
         self.ckpt = Checkpointer(cfg.checkpoint, obs=self.obs)
         self.guard = PreemptionGuard()
+        self._watchdog_halt = None
+        if jax.process_count() > 1 and self.obs.watchdog is not None:
+            # Multi-host --halt-on-unhealthy: a fatal alert on any one
+            # process must not raise there (the others would wedge in
+            # their next collective). Route it through the preemption
+            # guard instead — _stop_agreed's allgather then stops
+            # every host at a step boundary with a partial-epoch save,
+            # after which train() re-raises so the exit code still
+            # says "unhealthy" (2), not "clean preemption" (0).
+            def _halt(record):
+                # print (not log0): the detecting host may not be the
+                # coordinator, and its log is where the evidence goes.
+                print(f"[process {jax.process_index()}] HALT requested "
+                      f"by watchdog: {record}", flush=True)
+                self._watchdog_halt = record
+                self.guard.request()
+            self.obs.watchdog.on_fatal = _halt
         self.global_step = 0
         self.start_epoch = 1
         self.best_acc = 0.0
@@ -374,6 +391,9 @@ class Trainer:
                 # metric values; per-epoch-only, like the reference,
                 # when log_every_steps == 0).
                 sm = M.summarize(m)
+                # The loss is a host float here anyway — feed the
+                # watchdog's NaN/spike detector at no extra sync cost.
+                obs.observe_loss(self.global_step, sm["loss"])
                 # The step just taken consumed optax's PRE-increment
                 # count, i.e. schedule(global_step - 1) — print the LR
                 # that actually produced this loss.
@@ -466,6 +486,12 @@ class Trainer:
                 timer = Timer()
                 train_m = self.train_one_epoch(epoch)
                 train_secs = timer.elapsed()
+                # Watchdog loss checks run BEFORE the hard NaN guard:
+                # the obs_alert record lands in metrics.jsonl (and the
+                # live exporters) even when the guard below aborts the
+                # run, so the post-mortem explains itself. Under
+                # --halt-on-unhealthy this raises RunUnhealthyError.
+                self.obs.observe_loss(self.global_step, train_m["loss"])
                 if not np.isfinite(train_m["loss"]):
                     # Failure detection (SURVEY.md section 5: the
                     # reference has none — a NaN run would burn its full
@@ -508,6 +534,17 @@ class Trainer:
                         epoch=epoch, step=self.global_step,
                         units=train_m["count"],
                         train_seconds=train_secs, partial=True)
+                    if self._watchdog_halt is not None:
+                        # The "preemption" was the watchdog's agreed
+                        # multi-host halt: the partial state is saved,
+                        # now make the exit say UNHEALTHY — an
+                        # orchestrator that auto-requeues preemptions
+                        # must not silently restart a sick run.
+                        self.ckpt.wait()
+                        raise RunUnhealthyError(
+                            f"run unhealthy (agreed multi-host halt): "
+                            f"{self._watchdog_halt}; partial state "
+                            f"saved at epoch {epoch}")
                     break
                 test_m = self.evaluate()
                 secs = timer.elapsed()
