@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the serving engine.
+
+Drives the in-process ``tpunet.serve.Engine`` (no HTTP overhead in the
+measurement; ``--http`` targets a running server instead) with N
+concurrent closed-loop clients — each client keeps exactly one request
+in flight, so offered load is the concurrency level — and reports
+total throughput (tok/s), TTFT / end-to-end latency percentiles, and
+queue depth per concurrency level, plus the sequential
+one-request-at-a-time baseline the continuous-batching speedup is
+measured against (the ISSUE acceptance bar: >= 2x at concurrency 4).
+
+    python scripts/bench_serve.py                 # synthetic weights
+    python scripts/bench_serve.py --checkpoint-dir ckpt --vit-hidden 192
+    python scripts/bench_serve.py --http http://HOST:PORT --prompt-len 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def pct(xs, q):
+    if not xs:
+        return None
+    from tpunet.obs.registry import percentile_of_sorted
+    return percentile_of_sorted(sorted(xs), q)
+
+
+def ms(xs, q):
+    """Percentile in milliseconds, or None on no samples — an
+    all-errors run must still report its 'errors' list instead of
+    crashing on round(None)."""
+    p = pct(xs, q)
+    return None if p is None else round(1e3 * p, 2)
+
+
+def run_level(engine, concurrency, *, prompt_len, new_tokens,
+              requests_per_client, vocab, seed=0):
+    """Closed loop: each of ``concurrency`` clients fires
+    ``requests_per_client`` requests back-to-back."""
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+               for _ in range(concurrency)]
+    ttfts, e2es, depths = [], [], []
+    errors = []
+    done_tokens = [0] * concurrency
+
+    def client(i):
+        try:
+            for _ in range(requests_per_client):
+                req = engine.submit(prompts[i],
+                                    max_new_tokens=new_tokens)
+                req.result(timeout=600)
+                ttfts.append(req.ttft_s)
+                e2es.append(req.e2e_s)
+                done_tokens[i] += len(req.tokens)
+                depths.append(engine.queue.depth())
+        except Exception as e:  # noqa: BLE001 — report, don't hang
+            errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    total_tokens = sum(done_tokens)
+    return {
+        "concurrency": concurrency,
+        "requests": concurrency * requests_per_client,
+        "errors": errors,
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / wall, 1),
+        "ttft_p50_ms": ms(ttfts, 50),
+        "ttft_p90_ms": ms(ttfts, 90),
+        "ttft_p99_ms": ms(ttfts, 99),
+        "e2e_p50_ms": ms(e2es, 50),
+        "e2e_p99_ms": ms(e2es, 99),
+        "queue_depth_mean": round(float(np.mean(depths)), 2)
+        if depths else 0.0,
+        "queue_depth_max": int(max(depths)) if depths else 0,
+    }
+
+
+def run_http_level(base, concurrency, *, prompt_len, new_tokens,
+                   requests_per_client, vocab, seed=0):
+    """Same closed loop against a live server's /v1/generate."""
+    import urllib.request
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, size=prompt_len).tolist()
+               for _ in range(concurrency)]
+    ttfts, e2es = [], []
+    tokens = [0] * concurrency
+    errors = []
+
+    def client(i):
+        for _ in range(requests_per_client):
+            body = json.dumps({"tokens": prompts[i],
+                               "max_new_tokens": new_tokens}).encode()
+            req = urllib.request.Request(
+                base + "/v1/generate", body,
+                {"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    out = json.loads(r.read())
+                tokens[i] += len(out["tokens"])
+                ttfts.append(out["ttft_ms"] / 1e3)
+                e2es.append(out["e2e_ms"] / 1e3)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"client {i}: {type(e).__name__}: {e}")
+                return
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    total = sum(tokens)
+    return {
+        "concurrency": concurrency,
+        "requests": concurrency * requests_per_client,
+        "errors": errors,
+        "total_tokens": total,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total / wall, 1),
+        "ttft_p50_ms": ms(ttfts, 50),
+        "ttft_p99_ms": ms(ttfts, 99),
+        "e2e_p50_ms": ms(e2es, 50),
+        "e2e_p99_ms": ms(e2es, 99),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--http", default="",
+                    help="bench a RUNNING server at this base URL "
+                         "instead of an in-process engine")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="LM best checkpoint (default: random tiny "
+                         "weights — throughput shape, not quality)")
+    ap.add_argument("--vit-hidden", type=int, default=64)
+    ap.add_argument("--vit-depth", type=int, default=2)
+    ap.add_argument("--vit-heads", type=int, default=4)
+    ap.add_argument("--vocab-size", type=int, default=256)
+    ap.add_argument("--max-seq-len", type=int, default=512)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--requests-per-client", type=int, default=2)
+    ap.add_argument("--concurrency", default="1,2,4,8",
+                    help="comma-separated offered-load levels")
+    ap.add_argument("--out", default="",
+                    help="also write the result JSON here")
+    args = ap.parse_args()
+    levels = [int(c) for c in args.concurrency.split(",") if c]
+
+    if args.http:
+        results = [run_http_level(
+            args.http.rstrip("/"), c, prompt_len=args.prompt_len,
+            new_tokens=args.new_tokens,
+            requests_per_client=args.requests_per_client,
+            vocab=args.vocab_size) for c in levels]
+        out = {"mode": "http", "target": args.http, "levels": results}
+        print(json.dumps(out, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        return
+
+    import jax
+
+    from tpunet.config import ModelConfig, ServeConfig
+    from tpunet.models import create_model, init_variables, num_params
+    from tpunet.models.lm import generate
+    from tpunet.serve import Engine
+
+    model_cfg = ModelConfig(
+        name="lm", vit_hidden=args.vit_hidden, vit_depth=args.vit_depth,
+        vit_heads=args.vit_heads, vocab_size=args.vocab_size,
+        max_seq_len=args.max_seq_len, dropout_rate=0.0, dtype="float32")
+    if args.checkpoint_dir:
+        from tpunet.infer.generate import load_lm
+        model, variables = load_lm(model_cfg,
+                                   checkpoint_dir=args.checkpoint_dir)
+    else:
+        model = create_model(model_cfg)
+        variables = init_variables(model, jax.random.PRNGKey(0),
+                                   seq_len=16)
+
+    # Sequential baseline: the pre-serve shape — one request at a time
+    # through models.lm.generate (warmed compile).
+    p = np.zeros((1, args.prompt_len), np.int32)
+    generate(model, variables, p, n_new=2)
+    t0 = time.perf_counter()
+    n_seq = max(2, args.requests_per_client)
+    for _ in range(n_seq):
+        generate(model, variables, p, n_new=args.new_tokens)
+    seq_wall = time.perf_counter() - t0
+    seq_tps = n_seq * args.new_tokens / seq_wall
+
+    bucket = 1 << max(4, (args.prompt_len - 1).bit_length())
+    cfg = ServeConfig(slots=args.slots, queue_max=max(64, 4 * args.slots),
+                      prefill_buckets=(min(bucket, args.max_seq_len),),
+                      emit_every_s=0.0)
+    engine = Engine(model, variables, cfg).start()
+    try:
+        # warm prefill + decode programs outside the measurement
+        engine.submit(np.zeros(args.prompt_len, np.int32),
+                      max_new_tokens=2).result(timeout=600)
+        results = [run_level(
+            engine, c, prompt_len=args.prompt_len,
+            new_tokens=args.new_tokens,
+            requests_per_client=args.requests_per_client,
+            vocab=args.vocab_size) for c in levels]
+    finally:
+        engine.stop()
+    out = {
+        "mode": "engine",
+        "device": jax.devices()[0].device_kind,
+        "model_params": num_params(variables["params"]),
+        "slots": args.slots,
+        "prompt_len": args.prompt_len,
+        "new_tokens": args.new_tokens,
+        "sequential_tokens_per_s": round(seq_tps, 1),
+        "levels": results,
+        "speedup_vs_sequential": {
+            str(r["concurrency"]): round(r["tokens_per_s"] / seq_tps, 2)
+            for r in results},
+    }
+    print(json.dumps(out, indent=1))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,27 @@ def main() -> None:
               f"({args.steps * args.batch / wall:.0f} img/s/chip, incl. "
               "profiler overhead)", file=sys.stderr)
 
+    # Everything past the trace runs under try/finally: hlo_stats
+    # parses xprof columns by exact label (version-fragile) and the
+    # output write can fail too — neither may leak the mkdtemp trace
+    # dir this run created, or skip closing the trainer's
+    # checkpointer/threads.
+    try:
+        _attrib_and_write(args, trace_dir, wall)
+    finally:
+        if args.from_trace or args.keep_trace:
+            # Never delete a trace the CALLER owns (--from-trace) or
+            # asked to keep; only the tempdir this run created is
+            # cleaned up.
+            print(f"# trace kept at {trace_dir}", file=sys.stderr)
+        else:
+            import shutil
+            shutil.rmtree(trace_dir, ignore_errors=True)
+        if trainer is not None:
+            trainer.close()
+
+
+def _attrib_and_write(args, trace_dir: str, wall) -> None:
     rows = hlo_stats(trace_dir)
 
     def f(row, name, default=0.0):
@@ -193,15 +214,6 @@ def main() -> None:
                        "hbm_bound_mean_achieved_bw_gibs",
                        "by_category_pct")}, indent=1))
     print(f"# wrote {args.out}", file=sys.stderr)
-    if args.from_trace or args.keep_trace:
-        # Never delete a trace the CALLER owns (--from-trace) or asked
-        # to keep; only the tempdir this run created is cleaned up.
-        print(f"# trace kept at {trace_dir}", file=sys.stderr)
-    else:
-        import shutil
-        shutil.rmtree(trace_dir, ignore_errors=True)
-    if trainer is not None:
-        trainer.close()
 
 
 if __name__ == "__main__":

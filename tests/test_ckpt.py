@@ -192,6 +192,43 @@ def test_failed_best_save_rolls_back_sidecar(tmp_path):
     ckpt.close()
 
 
+def test_failed_async_phase_best_save_rolls_back_sidecar(tmp_path):
+    """StandardCheckpointer is an AsyncCheckpointer: save() can return
+    having only dispatched the write, with the failure surfacing later
+    at wait_until_finished(). The rollback must cover THAT phase too
+    (ADVICE r5): here save() succeeds synchronously and only the join
+    raises — the sidecar must still roll back, and the error must
+    still surface at the durability barrier."""
+    import jax.numpy as jnp
+
+    from tpunet.ckpt.orbax_io import Checkpointer
+
+    ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path),
+                                         save_best=True, save_last=False))
+    w = {"params": {"w": jnp.ones((4,))}}
+    ckpt.save_best(w, meta={"v": 1})
+    ckpt.wait()
+
+    real_wait = ckpt._best.wait_until_finished
+    fired = []
+
+    def async_boom():
+        # The dispatch (save()) already succeeded; the async
+        # write/commit fails exactly once, at the first join.
+        if not fired:
+            fired.append(True)
+            raise RuntimeError("async disk full")
+        return real_wait()
+
+    ckpt._best.wait_until_finished = async_boom
+    ckpt.save_best(w, meta={"v": 2})
+    with pytest.raises(RuntimeError, match="async disk full"):
+        ckpt.wait()
+    assert fired, "async phase was never joined inside the save"
+    assert ckpt.best_meta()["v"] == 1   # rolled back, not orphaned
+    ckpt.close()
+
+
 def test_async_save_overlaps_training(tmp_path):
     """The epoch-boundary save must NOT block the step loop: the
     dispatch returns while the write is still in progress (a ~200 MB

@@ -156,6 +156,23 @@ def test_shear_rotation_matches_gather_rotation():
         assert err.max() < 0.02, (deg, err.max())
         assert err.mean() < 0.003, (deg, err.mean())
 
+    # The dispatch boundary (ADVICE r5): the shear path serves every
+    # config up to rotation_degrees == 30, where the y-shear shifts
+    # edge columns by up to sin(30 deg) * 16 = 8 px — so the
+    # intermediate edge-clamp smearing penetrates deeper than at 15
+    # deg. Calibrated: with a 6 px interior margin the two rotations
+    # still agree tightly on smooth content at +-(25, 30) deg
+    # (measured interior max < 1e-4 here; band leaves headroom), which
+    # pins the geometry across the whole dispatched range.
+    for deg in (-30.0, -25.0, 25.0, 30.0):
+        a = jnp.float32(np.deg2rad(deg))
+        ref = np.asarray(_rotate_bilinear(jnp.asarray(smooth), a,
+                                          fill="edge"))
+        got = np.asarray(_rotate_shear(jnp.asarray(smooth), a))
+        err = np.abs(ref - got)[6:-6, 6:-6]
+        assert err.max() < 0.01, (deg, err.max())
+        assert err.mean() < 0.001, (deg, err.mean())
+
 
 def test_augment_large_rotation_uses_exact_path(monkeypatch):
     """rotation_degrees > 30 must dispatch the direct 4-tap gather

@@ -1,0 +1,336 @@
+"""HTTP frontend tests: end-to-end smoke against an ephemeral port
+(generate, streaming ndjson, classify micro-batching, healthz/metrics,
+backpressure status codes, obs_serve records in metrics.jsonl) and the
+slow-marked continuous-vs-sequential throughput regression."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from tpunet.config import DataConfig, ModelConfig, ServeConfig
+from tpunet.models import create_model, init_variables
+from tpunet.serve import ClassifyBatcher, Engine, ServeServer
+
+TINY = ModelConfig(name="lm", vit_hidden=32, vit_depth=2, vit_heads=2,
+                   dropout_rate=0.0, dtype="float32", vocab_size=256,
+                   max_seq_len=64)
+
+
+def make_server(tmp_path=None, *, with_classifier=False, **cfg_kw):
+    cfg_kw.setdefault("slots", 2)
+    cfg_kw.setdefault("queue_max", 4)
+    cfg_kw.setdefault("prefill_buckets", (16,))
+    cfg_kw.setdefault("default_max_new_tokens", 8)
+    cfg_kw.setdefault("emit_every_s", 0.0)
+    cfg = ServeConfig(**cfg_kw)
+    model = create_model(TINY)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+    engine = Engine(model, variables, cfg)
+    metrics_logger = None
+    if tmp_path is not None:
+        from tpunet.obs.registry import JsonlSink
+        from tpunet.utils.logging import MetricsLogger
+        metrics_logger = MetricsLogger(str(tmp_path))
+        engine.registry.add_sink(JsonlSink(metrics_logger))
+    batcher = None
+    if with_classifier:
+        from tpunet.infer.predict import Predictor
+        pred = Predictor(
+            model_cfg=ModelConfig(dtype="float32", width_mult=0.5,
+                                  dropout_rate=0.0),
+            data_cfg=DataConfig(image_size=32))
+        batcher = ClassifyBatcher(pred, batch_max=4, window_ms=5.0,
+                                  registry=engine.registry)
+    return ServeServer(engine, classify_batcher=batcher, port=0,
+                       metrics_logger=metrics_logger).start()
+
+
+def post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path, json.dumps(obj).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(base, path, timeout=30):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_end_to_end(tmp_path):
+    """One server, the whole surface: healthz, token + text generate,
+    parity with solo decode, streaming, classify 503 (none configured),
+    bad-request 400s, metrics, drain -> healthz 503 + obs_serve record
+    in metrics.jsonl."""
+    srv = make_server(tmp_path)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        code, health = get(base, "/healthz")
+        assert code == 200 and health["status"] == "ok"
+        assert health["slots"] == 2
+
+        code, out = post(base, "/v1/generate",
+                         {"prompt": "hello", "max_new_tokens": 5})
+        assert code == 200
+        assert len(out["tokens"]) == 5
+        assert out["finish_reason"] == "length"
+        assert isinstance(out["text"], str)
+        assert out["ttft_ms"] > 0 and out["e2e_ms"] >= out["ttft_ms"]
+
+        # token-id prompts hit the same engine path
+        code, out2 = post(base, "/v1/generate",
+                          {"tokens": [104, 101, 108, 108, 111],
+                           "max_new_tokens": 5})
+        assert code == 200 and out2["tokens"] == out["tokens"]
+
+        # streaming: ndjson token events, then the done frame
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            json.dumps({"prompt": "hi", "max_new_tokens": 4,
+                        "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            assert "ndjson" in r.headers["Content-Type"]
+            lines = [json.loads(line) for line in
+                     r.read().decode().strip().splitlines()]
+        assert len(lines) == 5
+        assert all("token" in ev for ev in lines[:4])
+        assert lines[-1] == {**lines[-1], "done": True,
+                             "finish_reason": "length", "n_tokens": 4}
+
+        # error surface
+        assert post(base, "/v1/generate", {})[0] == 400
+        assert post(base, "/v1/generate", {"tokens": []})[0] == 400
+        assert post(base, "/v1/generate",
+                    {"tokens": [999]})[0] == 400     # out of vocab
+        assert post(base, "/v1/generate",
+                    {"tokens": [1] * 40})[0] == 413  # > largest bucket
+        assert post(base, "/v1/classify", {"image": [[0]]})[0] == 503
+        assert get(base, "/nope")[0] == 404
+
+        code, snap = get(base, "/metrics")
+        assert code == 200
+        assert snap["serve_requests_total"] >= 3
+        assert snap["serve_tokens_total"] >= 14
+        assert "serve_ttft_s_p50" in snap
+
+    finally:
+        srv.drain(timeout=30.0)
+    # after drain the listener is down; the obs_serve record flushed
+    recs = [json.loads(line) for line in
+            (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    serve_recs = [r for r in recs if r.get("kind") == "obs_serve"]
+    assert serve_recs, "drain must flush a final obs_serve record"
+    final = serve_recs[-1]
+    assert final["final"] and final["requests_total"] >= 3
+    assert final["queue_depth"] == 0 and final["active_slots"] == 0
+
+
+def test_http_concurrent_parity_eight_requests():
+    """The ISSUE acceptance check: 8 concurrent POSTs through 2 slots
+    return token-identical output to solo greedy decode."""
+    from tpunet.models.lm import generate
+
+    srv = make_server(queue_max=8)
+    base = f"http://127.0.0.1:{srv.port}"
+    model = srv.engine.model
+    variables = srv.engine.variables
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 256, size=int(n)).astype(int).tolist()
+               for n in rng.integers(2, 10, size=8)]
+    results = [None] * 8
+
+    def worker(i):
+        results[i] = post(base, "/v1/generate",
+                          {"tokens": prompts[i], "max_new_tokens": 6})
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for p, res in zip(prompts, results):
+            assert res is not None, "worker timed out"
+            code, out = res
+            assert code == 200, out
+            solo = np.asarray(generate(
+                model, variables,
+                np.asarray(p, np.int32)[None], n_new=6))[0, len(p):]
+            assert out["tokens"] == solo.tolist()
+    finally:
+        srv.drain(timeout=10.0)
+
+
+def test_http_queue_full_returns_429():
+    """Backpressure over the wire: slots busy + queue at bound -> 429
+    queue_full, and the rejected counter ticks."""
+    srv = make_server(slots=1, queue_max=1,
+                      default_max_new_tokens=60)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        slow = []
+
+        def bg():
+            slow.append(post(base, "/v1/generate",
+                             {"prompt": "a", "max_new_tokens": 60}))
+
+        threads = [threading.Thread(target=bg) for _ in range(3)]
+        for t in threads:
+            t.start()
+            time.sleep(0.15)   # let each land: slot, queue, reject
+        got_429 = None
+        deadline = time.perf_counter() + 30
+        while got_429 is None and time.perf_counter() < deadline:
+            code, out = post(base, "/v1/generate",
+                             {"prompt": "b", "max_new_tokens": 60})
+            if code == 429:
+                got_429 = out
+            else:
+                time.sleep(0.05)
+        assert got_429 is not None, "never saw a 429 under overload"
+        assert got_429["error"] == "queue_full"
+        for t in threads:
+            t.join(timeout=300)
+        code, snap = get(base, "/metrics")
+        assert snap["serve_requests_rejected"] >= 1
+    finally:
+        srv.drain(timeout=10.0)
+
+
+def test_http_classify_micro_batched():
+    """Concurrent /v1/classify requests coalesce into shared batched
+    forwards and return the Predictor's exact probabilities."""
+    srv = make_server(with_classifier=True)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        rng = np.random.default_rng(0)
+        imgs = [rng.integers(0, 256, (32, 32, 3)).astype(int).tolist()
+                for _ in range(6)]
+        results = [None] * 6
+
+        def worker(i):
+            results[i] = post(base, "/v1/classify",
+                              {"image": imgs[i], "topk": 3})
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        pred = srv.classify.predictor
+        for img, res in zip(imgs, results):
+            assert res is not None
+            code, out = res
+            assert code == 200, out
+            assert len(out["topk"]) == 3
+            ref = pred.predict_probs(np.asarray(img, np.uint8))
+            got = np.asarray([out["probs"][n]
+                              for n in pred.class_names])
+            np.testing.assert_allclose(got, ref, atol=2e-5)
+        code, snap = get(base, "/metrics")
+        assert snap["serve_classify_requests_total"] == 6
+        # coalescing happened: fewer batches than requests
+        assert snap["serve_classify_batches_total"] < 6
+    finally:
+        srv.drain(timeout=10.0)
+
+
+def test_healthz_unhealthy_after_engine_crash():
+    srv = make_server()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("step exploded")
+
+        srv.engine._step = boom
+        try:
+            post(base, "/v1/generate", {"prompt": "x"}, timeout=60)
+        except Exception:
+            pass
+        deadline = time.perf_counter() + 30
+        code = 200
+        while code == 200 and time.perf_counter() < deadline:
+            code, health = get(base, "/healthz")
+            time.sleep(0.05)
+        assert code == 503
+        assert health["status"] == "unhealthy"
+        assert "step exploded" in health["error"]
+    finally:
+        srv.drain(timeout=10.0)
+
+
+def test_serve_cli_argparser_roundtrip():
+    """The module entry point's arg surface builds a coherent config
+    (no server start — just the parse + bucket plumbing)."""
+    from tpunet.serve.__main__ import build_argparser
+
+    args = build_argparser().parse_args(
+        ["--checkpoint-dir", "ck", "--slots", "3", "--queue-max", "5",
+         "--prefill-buckets", "8,32", "--port", "0",
+         "--vit-hidden", "32", "--vit-depth", "2", "--vit-heads", "2",
+         "--max-seq-len", "64"])
+    assert args.slots == 3 and args.queue_max == 5
+    assert args.prefill_buckets == "8,32"
+    assert args.vit_hidden == 32
+
+
+@pytest.mark.slow
+def test_continuous_batching_beats_sequential():
+    """The regression the subsystem exists for: at concurrency >= 4,
+    continuous batching through the slot pool must deliver >= 2x the
+    total tokens/s of one-request-at-a-time generation of the same
+    work (ISSUE acceptance bar; scripts/bench_serve.py measures the
+    same thing off-CI)."""
+    from tpunet.models.lm import generate
+
+    model = create_model(TINY)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+    n_req, n_new = 6, 24
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 256, size=6).astype(np.int32)
+               for _ in range(n_req)]
+
+    # sequential: one compiled single-token program, one request at a
+    # time (the tpunet/infer/generate.py serving shape) — warm up the
+    # compile first so both sides race steady-state.
+    generate(model, variables, prompts[0][None], n_new=2)
+    t0 = time.perf_counter()
+    for p in prompts:
+        generate(model, variables, p[None], n_new=n_new)
+    seq_s = time.perf_counter() - t0
+
+    cfg = ServeConfig(slots=n_req, queue_max=n_req,
+                      prefill_buckets=(8,), emit_every_s=0.0)
+    eng = Engine(model, variables, cfg).start()
+    try:
+        # warm both engine programs (prefill bucket + decode step)
+        eng.submit(prompts[0], max_new_tokens=2).result(timeout=120)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+        for r in reqs:
+            r.result(timeout=300)
+        batched_s = time.perf_counter() - t0
+    finally:
+        eng.stop()
+    speedup = seq_s / batched_s
+    assert speedup >= 2.0, (
+        f"continuous batching {n_req * n_new / batched_s:.0f} tok/s vs "
+        f"sequential {n_req * n_new / seq_s:.0f} tok/s "
+        f"(speedup {speedup:.2f}x < 2x)")

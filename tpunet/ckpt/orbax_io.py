@@ -247,6 +247,15 @@ class Checkpointer:
                 wrote_sidecar = True
             try:
                 self._best.save(path, snap, force=True)
+                # StandardCheckpointer is an AsyncCheckpointer: the
+                # write/commit runs on orbax's own background thread
+                # and its failure surfaces only at
+                # wait_until_finished(). Join it HERE, inside the same
+                # try — we already run on the dedicated worker thread,
+                # so blocking costs the step loop nothing, and an
+                # async-phase failure (disk full mid-write) now rolls
+                # the sidecar back like a synchronous one.
+                self._best.wait_until_finished()
             except BaseException:
                 # Roll the sidecar back: a failed best-save must not
                 # leave a NEW sidecar durably paired with the OLD

@@ -317,6 +317,48 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Production inference server (tpunet/serve/): a fixed pool of KV
+    slots decoded together by one jitted masked step (continuous
+    batching — requests join mid-flight, finished ones free their slot,
+    no recompilation), a bounded admission queue with backpressure, and
+    a stdlib HTTP frontend. The Gradio app (tpunet/infer/app.py) stays
+    the reference-parity demo; this is the heavy-traffic path."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+    # KV-slot pool size = max in-flight decodes = the jitted step's
+    # batch dimension. Compiled once; sizing it is the HBM/latency
+    # trade (docs/serving.md capacity guidance).
+    slots: int = 8
+    # Bounded admission: requests beyond this many waiting are REJECTED
+    # (429 queue-full) instead of growing latency unboundedly.
+    queue_max: int = 64
+    # Prefill programs are compiled per padded prompt-length bucket —
+    # the compile count is len(buckets), not one per prompt length.
+    # Prompts longer than the largest bucket are rejected.
+    prefill_buckets: Tuple[int, ...] = (32, 128, 512)
+    # Per-request caps: default/max new tokens, and a wall-clock
+    # deadline after which a request is cancelled and its slot freed
+    # (0 = no deadline).
+    default_max_new_tokens: int = 128
+    max_new_tokens_cap: int = 1024
+    default_deadline_s: float = 0.0
+    # Classifier micro-batching: hold a /v1/classify request at most
+    # this long to coalesce a batch, up to classify_batch_max images
+    # per jitted batched forward.
+    classify_batch_max: int = 8
+    classify_window_ms: float = 2.0
+    # Emit an ``obs_serve`` record (SLO counters/gauges/histograms)
+    # every this many seconds; 0 disables periodic emission (records
+    # still flush once on drain).
+    emit_every_s: float = 10.0
+    # Graceful-drain budget on SIGTERM: stop admitting, finish
+    # in-flight work for up to this long, then cancel survivors.
+    drain_timeout_s: float = 30.0
+
+
+@dataclass(frozen=True)
 class CheckpointConfig:
     directory: str = "checkpoints"
     save_best: bool = True            # reference best-by-test-acc (:238-240)

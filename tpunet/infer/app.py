@@ -10,6 +10,11 @@ replicate.
 
 Gradio is an optional dependency; import is gated so the rest of the
 framework never requires it.
+
+This app is the REFERENCE-PARITY demo: single-request, one forward per
+call. Production traffic goes through ``python -m tpunet.serve``
+(tpunet/serve/, docs/serving.md) — continuous batching, backpressure,
+SLO metrics.
 """
 
 from __future__ import annotations

@@ -56,17 +56,22 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False,
                  pos_offset=0, segment_ids=None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, decode_active=None):
         """``decode=True``: incremental step against the KV cache (one
         token per call after cache init); ``pos_offset`` is the absolute
-        position of ``tokens[:, 0]`` in the sequence. ``segment_ids``
-        [B, T] enables packed-sequence training: attention is masked to
-        same-segment tokens (composed with causality in the core).
-        ``return_hidden=True`` returns the final-LN hidden states
-        [B, T, C] float32 instead of logits — the vocab-sharded CE
-        hook (tpunet/ops/vocab_ce.py): the caller computes the loss
-        against the tied embedding without ever materializing the
-        [B, T, V] logits."""
+        position of ``tokens[:, 0]`` in the sequence — a scalar, or an
+        int32 [B] array giving each batch row its OWN position (the
+        tpunet/serve slot-pool engine: rows are independent requests at
+        different depths; T > 1 then runs a chunked causal prefill that
+        writes K/V for all T positions in one pass). ``decode_active``
+        [B] bool gates per-row cache writes (inactive slots stay
+        bit-frozen). ``segment_ids`` [B, T] enables packed-sequence
+        training: attention is masked to same-segment tokens (composed
+        with causality in the core). ``return_hidden=True`` returns the
+        final-LN hidden states [B, T, C] float32 instead of logits —
+        the vocab-sharded CE hook (tpunet/ops/vocab_ce.py): the caller
+        computes the loss against the tied embedding without ever
+        materializing the [B, T, V] logits."""
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -76,8 +81,18 @@ class TransformerLM(nn.Module):
         x = embed(tokens).astype(self.dtype)
         pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
                          (1, self.max_len, self.hidden), self.param_dtype)
-        x = x + jax.lax.dynamic_slice_in_dim(
-            pos, pos_offset, t, 1).astype(self.dtype)
+        per_row = getattr(pos_offset, "ndim", 0) == 1
+        if per_row:
+            # Per-row positions (serve engine): gather each row's slice
+            # of the position table; clip covers the padded tail of a
+            # bucketed prefill (those K/V are overwritten before any
+            # query can attend to them — engine invariant).
+            idx = jnp.clip(pos_offset[:, None] + jnp.arange(t)[None, :],
+                           0, self.max_len - 1)
+            x = x + jnp.take(pos[0], idx, axis=0).astype(self.dtype)
+        else:
+            x = x + jax.lax.dynamic_slice_in_dim(
+                pos, pos_offset, t, 1).astype(self.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         # remat only matters for training; never wrap the decode path.
         # (both flags are static: argnums count self as 0)
@@ -95,8 +110,10 @@ class TransformerLM(nn.Module):
                              moe_mesh=self.moe_mesh,
                              dropout_rate=self.dropout_rate,
                              dtype=self.dtype, param_dtype=self.param_dtype,
-                             name=f"block{i:02d}")(x, train, decode,
-                                                   segment_ids)
+                             name=f"block{i:02d}")(
+                                 x, train, decode, segment_ids,
+                                 pos_offset if per_row else None,
+                                 decode_active)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln")(x)
         if return_hidden:

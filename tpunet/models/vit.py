@@ -48,7 +48,18 @@ class Attention(nn.Module):
     O(T) per step instead of O(T^2) recompute. The cache buffers are
     created (sized by the input length) when the module is initialized
     with ``decode=True``; the injected attn_fn is bypassed in this mode
-    (single-query attention is computed inline)."""
+    (single-query attention is computed inline).
+
+    Serving hooks (tpunet/serve continuous batching): ``positions``
+    [B] int32 gives each batch row its OWN cache write index (rows
+    advance independently — the slot-pool engine keeps requests at
+    different depths in one batch), and generalizes the call to T >= 1
+    queries per row (chunked prefill: K/V for positions
+    ``positions[b] .. positions[b]+T-1`` are written in one pass,
+    causally masked). ``active`` [B] bool gates the cache write per
+    row — an inactive slot's cache is bit-frozen through any number of
+    steps. With ``positions`` given, the module's own ``cache_index``
+    is neither read nor advanced: the engine owns the clock."""
 
     heads: int
     attn_fn: AttnFn = dense_attention
@@ -58,7 +69,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
-                 segment_ids=None):
+                 segment_ids=None, positions=None, active=None):
         b, t, c = x.shape
         if c % self.heads:
             raise ValueError(
@@ -69,7 +80,7 @@ class Attention(nn.Module):
         qkv = qkv.reshape(b, t, 3, self.heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if decode:
-            y = self._decode_attend(q, k, v)
+            y = self._decode_attend(q, k, v, positions, active)
         elif segment_ids is not None:
             # Packed sequences: same-segment masking in the core. The
             # dense/flash cores and Ulysses SP take the kwarg (packed
@@ -86,7 +97,7 @@ class Attention(nn.Module):
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return y
 
-    def _decode_attend(self, q, k, v):
+    def _decode_attend(self, q, k, v, positions=None, active=None):
         is_init = not self.has_variable("cache", "cached_k")
         ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
         cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
@@ -98,21 +109,42 @@ class Attention(nn.Module):
             # and sharded cores would impose mesh divisibility on the
             # dummy shape — decode steps never call it).
             return jnp.zeros_like(q)
-        if q.shape[1] != 1:
-            raise ValueError(
-                f"decode processes one token per call, got {q.shape[1]}")
-        idx = ci.value
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
-        ci.value = idx + 1
+        b, t = q.shape[0], q.shape[1]
+        module_clock = positions is None
+        if module_clock:
+            # Legacy single-clock path (models.lm.generate): one shared
+            # index, one token per call, module-owned advance.
+            if t != 1:
+                raise ValueError(
+                    f"decode processes one token per call, got {t}")
+            positions = jnp.broadcast_to(ci.value, (b,))
+
+        # Per-row write of the new K/V at positions[b] .. positions[b]
+        # + t - 1 (vmapped dynamic_update_slice lowers to one scatter);
+        # inactive rows keep their cache bit-identical.
+        def write_row(cache_row, new_row, start):
+            return jax.lax.dynamic_update_slice(cache_row, new_row,
+                                                (start, 0, 0))
+        new_k = jax.vmap(write_row)(ck.value, k, positions)
+        new_v = jax.vmap(write_row)(cv.value, v, positions)
+        if active is not None:
+            gate = active[:, None, None, None]
+            new_k = jnp.where(gate, new_k, ck.value)
+            new_v = jnp.where(gate, new_v, cv.value)
+        ck.value, cv.value = new_k, new_v
+        if module_clock:
+            ci.value = ci.value + t
         kf, vf = ck.value, cv.value
         s = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
                        preferred_element_type=jnp.float32)
         s = s * (q.shape[-1] ** -0.5)
-        # current token sits at idx; only positions <= idx are real.
+        # query i of row b sits at positions[b] + i; only cache entries
+        # at or before it are real (causality per row).
         from tpunet.ops.attention import _NEG_INF
-        valid = jnp.arange(kf.shape[1])[None, None, None, :] <= idx
-        s = jnp.where(valid, s, _NEG_INF)
+        qpos = positions[:, None] + jnp.arange(t)[None, :]        # [B, T]
+        valid = (jnp.arange(kf.shape[1])[None, None, :]
+                 <= qpos[:, :, None])                             # [B,T,K]
+        s = jnp.where(valid[:, None, :, :], s, _NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         y = jnp.einsum("bhqk,bkhd->bqhd", p, vf,
                        preferred_element_type=jnp.float32)
@@ -160,13 +192,14 @@ class EncoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
-                 segment_ids=None):
+                 segment_ids=None, positions=None, active=None):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln1")(x)
         x = x + Attention(self.heads, attn_fn=self.attn_fn,
                           dropout_rate=self.dropout_rate, dtype=self.dtype,
                           param_dtype=self.param_dtype,
-                          name="attn")(y, train, decode, segment_ids)
+                          name="attn")(y, train, decode, segment_ids,
+                                       positions, active)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln2")(x)
         if self.moe_experts > 0:

@@ -12,6 +12,7 @@ per-step sampling).
 from __future__ import annotations
 
 import random
+import threading
 from typing import Dict, List, Optional
 
 
@@ -32,15 +33,19 @@ def percentile_of_sorted(xs: List[float], q: float) -> float:
 
 class Counter:
     """Monotonically increasing sum (e.g. checkpoint saves, stall
-    seconds)."""
+    seconds). ``inc`` is thread-safe: the serving path increments from
+    HTTP handler threads concurrently with the engine thread, and an
+    unlocked float read-modify-write can lose updates."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 class Gauge:
@@ -171,13 +176,22 @@ class JsonlSink:
 
 class Registry:
     """Named instruments + sinks. ``counter``/``gauge``/``histogram``
-    are get-or-create, so call sites never coordinate registration."""
+    are get-or-create, so call sites never coordinate registration.
+
+    Creation and ``snapshot()`` hold a lock: the serving frontend
+    snapshots from HTTP handler threads while the engine thread
+    lazily creates instruments, and an unguarded dict iteration over
+    a mutating family raises RuntimeError. The trainer's
+    single-threaded hot path pays one uncontended acquire per
+    get-or-create call (instrument methods themselves stay lock-free
+    except Counter.inc)."""
 
     def __init__(self):
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._sinks: list = []
+        self._lock = threading.Lock()
 
     def _claim(self, name: str, family: Dict) -> None:
         """One name, one instrument family: a counter and a gauge
@@ -193,22 +207,25 @@ class Registry:
                     f"{kind}; one name maps to one snapshot() key")
 
     def counter(self, name: str) -> Counter:
-        if name not in self._counters:
-            self._claim(name, self._counters)
-        return self._counters.setdefault(name, Counter())
+        with self._lock:
+            if name not in self._counters:
+                self._claim(name, self._counters)
+            return self._counters.setdefault(name, Counter())
 
     def gauge(self, name: str) -> Gauge:
-        if name not in self._gauges:
-            self._claim(name, self._gauges)
-        return self._gauges.setdefault(name, Gauge())
+        with self._lock:
+            if name not in self._gauges:
+                self._claim(name, self._gauges)
+            return self._gauges.setdefault(name, Gauge())
 
     def histogram(self, name: str,
                   max_samples: int = Histogram.DEFAULT_MAX_SAMPLES
                   ) -> Histogram:
-        if name not in self._histograms:
-            self._claim(name, self._histograms)
-            self._histograms[name] = Histogram(max_samples)
-        return self._histograms[name]
+        with self._lock:
+            if name not in self._histograms:
+                self._claim(name, self._histograms)
+                self._histograms[name] = Histogram(max_samples)
+            return self._histograms[name]
 
     def add_sink(self, sink) -> None:
         self._sinks.append(sink)
@@ -228,21 +245,24 @@ class Registry:
         counter/gauge name — is disambiguated by suffixing the derived
         key with ``_hist`` instead of silently overwriting."""
         out: Dict[str, float] = {}
-        for name, c in self._counters.items():
-            out[name] = c.value
-        for name, g in self._gauges.items():
-            if g.value is not None:
-                out[name] = g.value
-        for name, h in self._histograms.items():
-            for k, v in h.summary().items():
-                key = f"{name}_{k}"
-                while key in out:
-                    key += "_hist"
-                out[key] = v
+        with self._lock:
+            for name, c in self._counters.items():
+                out[name] = c.value
+            for name, g in self._gauges.items():
+                if g.value is not None:
+                    out[name] = g.value
+            for name, h in self._histograms.items():
+                for k, v in h.summary().items():
+                    key = f"{name}_{k}"
+                    while key in out:
+                        key += "_hist"
+                    out[key] = v
         return out
 
     def reset_window(self) -> None:
         """Start a new observation window: histograms clear; counters
         and gauges persist (they are run-cumulative)."""
-        for h in self._histograms.values():
+        with self._lock:
+            hists = list(self._histograms.values())
+        for h in hists:
             h.reset()

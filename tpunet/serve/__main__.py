@@ -1,0 +1,198 @@
+"""CLI entry point: ``python -m tpunet.serve --checkpoint-dir ...``.
+
+Loads the LM family best checkpoint through the same
+``infer.generate.load_lm`` path the generation CLI uses (pipeline
+checkpoints unstack, tensor-parallel serving via ``--mesh-model``),
+optionally a classifier checkpoint for the micro-batched
+``/v1/classify`` path, wires the obs registry into ``metrics.jsonl``
+and any configured live exporters, and serves until SIGTERM/SIGINT —
+which triggers a graceful drain (stop admitting, finish in-flight,
+flush telemetry) rather than dropping connections.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+
+
+def build_argparser():
+    import argparse
+
+    from tpunet.config import ServeConfig
+
+    d = ServeConfig()
+    p = argparse.ArgumentParser(
+        prog="python -m tpunet.serve",
+        description="tpunet production inference server")
+    p.add_argument("--checkpoint-dir", default="checkpoints",
+                   help="LM best-checkpoint directory (infer.generate "
+                        "load_lm path)")
+    p.add_argument("--host", default=d.host)
+    p.add_argument("--port", type=int, default=d.port)
+    p.add_argument("--slots", type=int, default=d.slots,
+                   help="KV-slot pool size = max in-flight decodes")
+    p.add_argument("--queue-max", type=int, default=d.queue_max,
+                   help="bounded admission queue; beyond it requests "
+                        "are rejected 429 (backpressure)")
+    p.add_argument("--prefill-buckets", default=",".join(
+        str(b) for b in d.prefill_buckets),
+        help="comma-separated padded prompt-length buckets (compile "
+             "count = number of buckets)")
+    p.add_argument("--max-new-tokens", type=int,
+                   default=d.default_max_new_tokens,
+                   help="default per-request generation budget")
+    p.add_argument("--deadline-s", type=float,
+                   default=d.default_deadline_s,
+                   help="default per-request wall-clock deadline "
+                        "(0 = none)")
+    p.add_argument("--classify-batch-max", type=int,
+                   default=d.classify_batch_max)
+    p.add_argument("--classify-window-ms", type=float,
+                   default=d.classify_window_ms)
+    p.add_argument("--emit-every-s", type=float, default=d.emit_every_s,
+                   help="obs_serve record cadence into metrics.jsonl")
+    p.add_argument("--drain-timeout-s", type=float,
+                   default=d.drain_timeout_s)
+    p.add_argument("--metrics-dir", default="",
+                   help="directory for metrics.jsonl (default: the "
+                        "checkpoint dir); obs records share the "
+                        "docs/metrics_schema.md contract")
+    p.add_argument("--statsd", default="", metavar="HOST:PORT",
+                   help="stream obs_serve records as statsd/UDP gauges")
+    p.add_argument("--obs-http", default="", metavar="URL",
+                   help="POST obs_serve records as line-JSON")
+    # LM architecture (must match the trained checkpoint) — mirrors
+    # tpunet.infer.generate's flags.
+    p.add_argument("--model", choices=("lm", "lm_pp"), default="lm")
+    p.add_argument("--vit-hidden", type=int, default=192)
+    p.add_argument("--vit-depth", type=int, default=6)
+    p.add_argument("--vit-heads", type=int, default=3)
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--max-seq-len", type=int, default=1024)
+    p.add_argument("--moe-experts", type=int, default=0)
+    p.add_argument("--moe-every", type=int, default=2)
+    p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25)
+    p.add_argument("--mesh-model", type=int, default=0,
+                   help="tensor-parallel serving: shard block weights "
+                        "and the KV pool's head dim over N devices")
+    p.add_argument("--train-pipe", type=int, default=0)
+    p.add_argument("--pp-virtual", type=int, default=2)
+    # Optional classifier endpoint.
+    p.add_argument("--classifier-checkpoint-dir", default="",
+                   help="also serve /v1/classify from this MobileNetV2/"
+                        "ViT best checkpoint (micro-batched)")
+    p.add_argument("--classifier-model", default="mobilenet_v2")
+    p.add_argument("--classifier-image-size", type=int, default=224)
+    return p
+
+
+def build_server(args):
+    """Construct (but do not start) the ServeServer from parsed args —
+    shared by main() and tests."""
+    import dataclasses
+
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, ServeConfig)
+    from tpunet.infer.generate import load_lm
+    from tpunet.obs.registry import JsonlSink
+    from tpunet.serve.classify import ClassifyBatcher
+    from tpunet.serve.engine import Engine
+    from tpunet.serve.frontend import ServeServer
+    from tpunet.utils.logging import MetricsLogger
+
+    buckets = tuple(int(b) for b in
+                    str(args.prefill_buckets).split(",") if b)
+    cfg = ServeConfig(
+        host=args.host, port=args.port, slots=args.slots,
+        queue_max=args.queue_max, prefill_buckets=buckets,
+        default_max_new_tokens=args.max_new_tokens,
+        default_deadline_s=args.deadline_s,
+        classify_batch_max=args.classify_batch_max,
+        classify_window_ms=args.classify_window_ms,
+        emit_every_s=args.emit_every_s,
+        drain_timeout_s=args.drain_timeout_s)
+    model_cfg = ModelConfig(
+        name=args.model, vit_hidden=args.vit_hidden,
+        vit_depth=args.vit_depth, vit_heads=args.vit_heads,
+        vocab_size=args.vocab_size, max_seq_len=args.max_seq_len,
+        dropout_rate=0.0, moe_experts=args.moe_experts,
+        moe_every=args.moe_every, moe_top_k=args.moe_top_k,
+        moe_capacity_factor=args.moe_capacity_factor,
+        pp_virtual=args.pp_virtual)
+    mesh = None
+    if args.mesh_model > 1:
+        from tpunet.parallel import make_mesh
+        mesh = make_mesh(MeshConfig(data=1, model=args.mesh_model))
+    model, variables = load_lm(model_cfg,
+                               checkpoint_dir=args.checkpoint_dir,
+                               mesh=mesh, train_pipe=args.train_pipe)
+    engine = Engine(model, variables, cfg, mesh=mesh)
+    registry = engine.registry
+
+    metrics_logger = None
+    exporters = []
+    metrics_dir = args.metrics_dir or args.checkpoint_dir
+    if metrics_dir:
+        metrics_logger = MetricsLogger(metrics_dir, resume=True)
+        registry.add_sink(JsonlSink(metrics_logger))
+    if args.statsd or args.obs_http:
+        from tpunet.config import ExportConfig
+        from tpunet.obs.export import build_exporters
+        exporters = build_exporters(
+            ExportConfig(statsd=args.statsd, http=args.obs_http),
+            registry)
+        for exporter in exporters:
+            registry.add_sink(exporter)
+
+    batcher = None
+    if args.classifier_checkpoint_dir:
+        from tpunet.infer.predict import Predictor
+        pred = Predictor(
+            model_cfg=ModelConfig(name=args.classifier_model,
+                                  dropout_rate=0.0),
+            data_cfg=DataConfig(image_size=args.classifier_image_size),
+            checkpoint_dir=args.classifier_checkpoint_dir)
+        batcher = ClassifyBatcher(pred,
+                                  batch_max=cfg.classify_batch_max,
+                                  window_ms=cfg.classify_window_ms,
+                                  registry=registry)
+    return ServeServer(engine, classify_batcher=batcher,
+                       host=cfg.host, port=cfg.port,
+                       metrics_logger=metrics_logger,
+                       exporters=exporters)
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    server = build_server(args)
+    server.start()
+    print(f"tpunet.serve listening on "
+          f"http://{args.host}:{server.port} "
+          f"(slots={server.engine.slots}, "
+          f"buckets={server.engine.buckets})", flush=True)
+
+    import threading
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        print(f"signal {signum}: draining "
+              f"(timeout {args.drain_timeout_s}s)...", flush=True)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stop.is_set():
+        stop.wait(0.5)
+        if not server.engine.healthy:
+            print(f"engine unhealthy: {server.engine.error}; "
+                  "draining", file=sys.stderr, flush=True)
+            stop.set()
+    clean = server.drain(args.drain_timeout_s)
+    print(f"drained ({'clean' if clean else 'forced'})", flush=True)
+    return 0 if server.engine.error is None else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Micro-batched classifier path: coalesce concurrent /v1/classify
+requests into one jitted batched forward.
+
+The parity ``Predictor`` (tpunet/infer/predict.py) jits a
+single-image forward — correct, but a thread-per-request server then
+pays one full model dispatch per image. Here concurrent requests are
+held for at most ``classify_window_ms`` and run as ONE batched forward
+padded to a fixed ``classify_batch_max`` — a single compiled program
+for the MODEL forward (the expensive part) regardless of arrival
+pattern: padding rows are zero images whose outputs are dropped.
+Preprocessing (resize + normalize) runs per-image on the handler
+thread via eager ``jax.image.resize`` — the Predictor's exact
+transform, which specializes per input image shape exactly like the
+parity Predictor's jitted forward does (that per-novel-shape compile
+is the price of bit-matching its antialiased downscale; clients with
+a fixed camera/image size pay it once).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+
+class _Pending:
+    __slots__ = ("image", "event", "probs", "error")
+
+    def __init__(self, image: np.ndarray):
+        self.image = image
+        self.event = threading.Event()
+        self.probs: Optional[np.ndarray] = None
+        self.error: Optional[str] = None
+
+
+class ClassifyBatcher:
+    """Wraps a ``Predictor`` with a batching window.
+
+    ``submit(image)`` blocks the CALLING (HTTP handler) thread until
+    its probs are ready; the single worker thread owns the device.
+    """
+
+    def __init__(self, predictor, *, batch_max: int = 8,
+                 window_ms: float = 2.0, registry=None):
+        import jax
+        import jax.numpy as jnp
+
+        from tpunet.obs.registry import Registry
+
+        if batch_max < 1:
+            raise ValueError(f"batch_max must be >= 1, got {batch_max}")
+        self.predictor = predictor
+        self.batch_max = int(batch_max)
+        self.window_s = float(window_ms) / 1000.0
+        self.registry = registry if registry is not None else Registry()
+        self._q: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        size = predictor.data_cfg.image_size
+        self._size = size
+        self._mean = np.asarray(predictor.data_cfg.mean, np.float32)
+        self._std = np.asarray(predictor.data_cfg.std, np.float32)
+
+        def forward(variables, batch):
+            logits = predictor.model.apply(variables, batch, train=False)
+            return jax.nn.softmax(logits, axis=-1)
+
+        self._forward = jax.jit(forward)
+        self._jnp = jnp
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpunet-serve-classify")
+        self._thread.start()
+
+    @property
+    def healthy(self) -> bool:
+        return self._thread.is_alive()
+
+    def _preprocess(self, image: np.ndarray) -> np.ndarray:
+        """The Predictor's serve-time transform (uint8 HWC in,
+        normalized float32 SxS out) — one constant everywhere, so the
+        batched path cannot re-introduce the reference's train/serve
+        normalization skew."""
+        import jax
+        x = image.astype(np.float32) / 255.0
+        x = np.asarray(jax.image.resize(
+            x, (self._size, self._size, 3), method="bilinear"))
+        return (x - self._mean) / self._std
+
+    def submit(self, image, timeout: float = 30.0) -> np.ndarray:
+        """Classify one image (uint8 HWC array or PIL); returns class
+        probabilities. Blocks until the batched forward that includes
+        this image completes."""
+        if hasattr(image, "convert"):
+            image = np.asarray(image.convert("RGB"))
+        image = np.asarray(image)
+        if image.dtype != np.uint8:
+            image = np.clip(image * 255 if image.max() <= 1.0 else image,
+                            0, 255).astype(np.uint8)
+        item = _Pending(self._preprocess(image))
+        self._q.put(item)
+        if not item.event.wait(timeout):
+            raise TimeoutError("classify batch did not complete "
+                               f"within {timeout}s")
+        if item.error is not None:
+            raise RuntimeError(item.error)
+        return item.probs
+
+    def _run(self) -> None:
+        reg = self.registry
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.perf_counter() + self.window_s
+            while len(batch) < self.batch_max:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=left))
+                except queue.Empty:
+                    break
+            t0 = time.perf_counter()
+            try:
+                x = np.zeros((self.batch_max, self._size, self._size, 3),
+                             np.float32)
+                for i, item in enumerate(batch):
+                    x[i] = item.image
+                probs = np.asarray(self._forward(
+                    self.predictor.variables, self._jnp.asarray(x)))
+                for i, item in enumerate(batch):
+                    item.probs = probs[i]
+                    item.event.set()
+            except Exception as e:  # noqa: BLE001 — fail the batch, not
+                # the worker: the next window must still serve.
+                for item in batch:
+                    item.error = f"{type(e).__name__}: {e}"
+                    item.event.set()
+            reg.counter("serve_classify_requests_total").inc(len(batch))
+            reg.counter("serve_classify_batches_total").inc()
+            reg.histogram("serve_classify_batch_size").observe(len(batch))
+            reg.histogram("serve_classify_s").observe(
+                time.perf_counter() - t0)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

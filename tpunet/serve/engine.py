@@ -1,0 +1,524 @@
+"""Continuous-batching decode engine over a fixed KV-slot pool.
+
+One jitted masked decode step is compiled ONCE for the pool batch
+``[slots, 1]`` and amortized across every in-flight request: each
+iteration feeds every active slot its next token at its own position
+(per-row positions + active mask, tpunet/models/vit.py
+``Attention._decode_attend``), so requests join mid-flight and finished
+ones free their slot without any recompilation. Prefill runs through
+the same masked path as a chunked multi-token call, padded to one of a
+fixed set of length buckets — the total compile count is bounded at
+``1 + len(prefill_buckets)`` programs for the life of the server.
+
+Sampling is host-side (per-request temperature/top-k/top-p/seed differ
+across a batch, and argmax on host equals argmax on device), mirroring
+``models.lm.filter_logits`` semantics: top-k first, then the nucleus
+over the renormalized post-top-k distribution. Greedy output is
+token-identical to ``models.lm.generate`` (engine parity test).
+
+Obs wiring: SLO counters/gauges/histograms land in a ``tpunet.obs``
+``Registry`` (serve_* names, docs/metrics_schema.md ``obs_serve``),
+prefill/decode phases run under trace spans, and a periodic
+``obs_serve`` record is emitted to every attached sink/exporter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from tpunet.serve.scheduler import (FINISH_CANCELLED, FINISH_DEADLINE,
+                                    FINISH_DRAIN, FINISH_ERROR,
+                                    FINISH_LENGTH, FINISH_STOP,
+                                    GenerateRequest, RequestQueue)
+
+
+class PromptTooLongError(Exception):
+    """Prompt exceeds the largest prefill bucket or the KV length."""
+
+
+def sample_token(logits: np.ndarray, req: GenerateRequest) -> int:
+    """Host-side next-token choice from one row of logits [V].
+
+    Greedy (temperature <= 0) is exact argmax. Sampling mirrors
+    ``models.lm.filter_logits``: top-k truncation first, then nucleus
+    over the renormalized post-top-k distribution; the draw uses the
+    request's own seeded numpy Generator (deterministic per request,
+    independent across slots).
+    """
+    if req.temperature <= 0:
+        return int(np.argmax(logits))
+    lg = logits.astype(np.float64) / req.temperature
+    v = lg.shape[-1]
+    if req.top_k > 0 and req.top_k < v:
+        kth = np.sort(lg)[-req.top_k]
+        lg = np.where(lg >= kth, lg, -np.inf)
+    if 0.0 < req.top_p < 1.0:
+        srt = np.sort(lg)[::-1]
+        probs = np.exp(srt - srt.max())
+        probs /= probs.sum()
+        keep = np.cumsum(probs) - probs < req.top_p
+        cutoff = srt[keep].min()
+        lg = np.where(lg >= cutoff, lg, -np.inf)
+    lg -= lg.max()
+    p = np.exp(lg)
+    p /= p.sum()
+    return int(req.rng().choice(v, p=p))
+
+
+class _Slot:
+    """Host-side bookkeeping for one KV-cache row."""
+
+    __slots__ = ("req", "pos", "next_token", "generated")
+
+    def __init__(self, req: GenerateRequest, pos: int, next_token: int):
+        self.req = req
+        self.pos = pos            # next cache write position
+        self.next_token = next_token
+        self.generated = 1        # first token came from prefill
+
+
+class Engine:
+    """Slot-pool continuous-batching engine for one LM.
+
+    ``model``/``variables`` come from ``infer.generate.load_lm`` (pass
+    the same ``mesh`` for tensor-parallel serving — the KV pool is then
+    created sharded over the mesh 'model' axis to match the attention's
+    head-sharded writes). The engine owns a single background thread;
+    ``submit`` is thread-safe and non-blocking (bounded queue).
+    """
+
+    def __init__(self, model, variables, cfg, *, registry=None,
+                 mesh=None):
+        import jax
+        import jax.numpy as jnp
+
+        from tpunet.obs.registry import Registry
+
+        self.model = model
+        self.variables = variables
+        self.cfg = cfg
+        self.mesh = mesh
+        self.registry = registry if registry is not None else Registry()
+        self.max_seq_len = int(model.max_len)
+        self.slots = int(cfg.slots)
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {cfg.slots}")
+        self.buckets = tuple(sorted(
+            b for b in cfg.prefill_buckets if b <= self.max_seq_len))
+        if not self.buckets:
+            self.buckets = (self.max_seq_len,)
+        self.queue = RequestQueue(cfg.queue_max,
+                                  on_finish=self._account_finish)
+        self._active: List[Optional[_Slot]] = [None] * self.slots
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._drain_kill = threading.Event()
+        self._drained = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.error: Optional[str] = None
+        self._last_emit = time.perf_counter()
+        self._started = time.perf_counter()
+
+        # -- device programs (compiled lazily, one per shape) ----------
+        def _masked_step(params, cache, tokens, positions, active):
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, tokens, train=False,
+                decode=True, pos_offset=positions, decode_active=active,
+                mutable=["cache"])
+            return mutated["cache"], logits
+
+        # One callable; jit specializes per token shape: [N, 1] decode
+        # plus one [N, Lb] program per prefill bucket. The cache is
+        # donated — it is the engine's single biggest buffer and every
+        # call replaces it.
+        self._step = jax.jit(_masked_step, donate_argnums=(1,))
+        self._cache = self._make_cache()
+        self._inactive_tok = np.zeros((self.slots, 1), np.int32)
+
+    # -- pool construction ---------------------------------------------
+
+    def _make_cache(self):
+        import jax
+        import jax.numpy as jnp
+        shapes = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((self.slots, self.max_seq_len), jnp.int32),
+                decode=True))
+
+        def zeros(s):
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                tp = self.mesh.shape.get("model", 1)
+                spec = (P(None, None, "model", None)
+                        if (s.ndim == 4 and tp > 1
+                            and s.shape[2] % tp == 0) else P())
+                return jnp.zeros(s.shape, s.dtype,
+                                 device=NamedSharding(self.mesh, spec))
+            return jnp.zeros(s.shape, s.dtype)
+
+        return jax.tree_util.tree_map(zeros, shapes["cache"])
+
+    # -- public API ------------------------------------------------------
+
+    def start(self) -> "Engine":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpunet-serve-engine")
+        self._thread.start()
+        return self
+
+    @property
+    def healthy(self) -> bool:
+        return (self.error is None and self._thread is not None
+                and self._thread.is_alive())
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def active_slots(self) -> int:
+        return sum(1 for s in self._active if s is not None)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise PromptTooLongError(
+            f"prompt of {prompt_len} tokens exceeds the largest "
+            f"prefill bucket ({self.buckets[-1]})")
+
+    def submit(self, prompt, **kw) -> GenerateRequest:
+        """Admit a request (or raise QueueFullError / DrainingError /
+        PromptTooLongError / ValueError). Clamps max_new_tokens to the
+        KV length; never blocks."""
+        if self.error is not None:
+            from tpunet.serve.scheduler import DrainingError
+            raise DrainingError(f"engine failed: {self.error}")
+        kw.setdefault("max_new_tokens", self.cfg.default_max_new_tokens)
+        kw["max_new_tokens"] = min(int(kw["max_new_tokens"]),
+                                   self.cfg.max_new_tokens_cap)
+        if (kw.get("deadline_s") or 0) <= 0 \
+                and self.cfg.default_deadline_s > 0:
+            kw["deadline_s"] = self.cfg.default_deadline_s
+        req = GenerateRequest(prompt, **kw)
+        try:
+            n = int(req.prompt.size)
+            self.bucket_for(n)  # raises PromptTooLongError
+            if n + req.max_new_tokens > self.max_seq_len:
+                req.max_new_tokens = self.max_seq_len - n
+                if req.max_new_tokens < 1:
+                    raise PromptTooLongError(
+                        f"prompt of {n} tokens leaves no room to "
+                        f"generate (max_seq_len {self.max_seq_len})")
+            self.queue.submit(req)       # may raise QueueFull/Draining
+        except Exception:
+            self.registry.counter("serve_requests_rejected").inc()
+            raise
+        self.registry.counter("serve_requests_total").inc()
+        self.registry.gauge("serve_queue_depth").set(self.queue.depth())
+        self._wake.set()
+        return req
+
+    def _kill_survivors(self, reason: str) -> None:
+        """Finish every in-flight and still-queued request with
+        ``reason``, through the shared accounting. Only safe from the
+        engine thread, or once it can no longer run."""
+        for i, slot in enumerate(self._active):
+            if slot is not None:
+                self._finish_slot(i, reason)
+        while True:
+            reqs = self.queue.pop_ready(self.queue.queue_max)
+            if not reqs:
+                break
+            for req in reqs:
+                req.finish(reason)
+                self._account_finish(req, reason)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admitting, let in-flight (and
+        already-queued) requests finish, then stop the loop. Returns
+        True when everything finished inside the timeout; leftovers are
+        cancelled with finish_reason='drain'."""
+        self._draining.set()
+        waiting = self.queue.close()
+        self._wake.set()
+        if self._thread is None or not self._thread.is_alive():
+            # Never started (or already dead): there is no loop to
+            # finish the work — fail fast instead of waiting a budget
+            # that can never be met.
+            clean = self.active_slots() == 0 and not waiting
+            self._kill_survivors(FINISH_DRAIN)
+            self._stop.set()
+            self._drained.set()
+            return clean
+        budget = timeout if timeout is not None \
+            else self.cfg.drain_timeout_s
+        clean = self._drained.wait(budget)
+        if not clean:
+            # Timeout: the ENGINE finishes survivors (in-flight and
+            # still-queued alike) with reason 'drain' — through
+            # _finish_slot so the serve_finished_drain counters and
+            # e2e accounting stay truthful, and distinguishable from a
+            # client-initiated cancel.
+            self._drain_kill.set()
+            self._wake.set()
+            self._drained.wait(5.0)
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        return clean
+
+    def stop(self) -> None:
+        """Hard stop (tests / error paths): cancel everything. Unlike
+        cancel() alone, every in-flight request is FINISHED here —
+        clients blocked in result()/events() must unblock now, not at
+        their own timeout."""
+        self._draining.set()
+        self.queue.fail_all("engine stopped")
+        for slot in list(self._active):
+            if slot is not None:
+                slot.req.cancel()
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        # The loop exits at the top of its while without a final reap:
+        # finish whatever it left behind (thread joined or never ran,
+        # so this is single-threaded now).
+        self._kill_survivors(FINISH_CANCELLED)
+
+    # -- engine loop -----------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                did_work = self._iterate()
+                if self._draining.is_set() and self.active_slots() == 0 \
+                        and self.queue.depth() == 0:
+                    break
+                if not did_work:
+                    self._wake.wait(timeout=0.02)
+                    self._wake.clear()
+            self._emit_record(final=True)
+        except BaseException as e:  # noqa: BLE001 — engine death is a
+            # liveness event: surface through /healthz and fail every
+            # request fast rather than hanging clients.
+            self.error = f"{type(e).__name__}: {e}"
+            for slot in self._active:
+                if slot is not None:
+                    slot.req.finish(FINISH_ERROR, error=self.error)
+            self._active = [None] * self.slots
+            self.queue.fail_all(self.error)
+        finally:
+            self._drained.set()
+
+    def _iterate(self) -> bool:
+        """One engine iteration: reap -> admit(prefill) -> decode.
+        Returns False when there was nothing to do (caller sleeps)."""
+        if self._drain_kill.is_set():
+            # Drain timeout expired: everything still alive finishes
+            # with reason 'drain' (the shutdown took it, not a client).
+            self._kill_survivors(FINISH_DRAIN)
+            return False
+        self._reap()
+        admitted = self._admit()
+        stepped = self._decode_iteration()
+        now = time.perf_counter()
+        if self.cfg.emit_every_s > 0 \
+                and now - self._last_emit >= self.cfg.emit_every_s:
+            self._emit_record()
+        return admitted or stepped
+
+    def _reap(self) -> None:
+        """Free slots whose request was cancelled or hit its deadline
+        (cooperative cancellation point)."""
+        now = time.perf_counter()
+        for i, slot in enumerate(self._active):
+            if slot is None:
+                continue
+            if slot.req.cancelled:
+                self._finish_slot(i, FINISH_CANCELLED)
+            elif slot.req.expired(now):
+                self._finish_slot(i, FINISH_DEADLINE)
+
+    def _account_finish(self, req, reason: str) -> None:
+        """Finish accounting shared by slot-finishes and requests the
+        QUEUE finishes before they ever reach a slot: the counters must
+        reconcile (requests_total == rejected + sum(finished_*))."""
+        reg = self.registry
+        reg.counter(f"serve_finished_{reason}").inc()
+        if reason in (FINISH_LENGTH, FINISH_STOP):
+            reg.counter("serve_requests_completed").inc()
+        if req.e2e_s is not None:
+            reg.histogram("serve_e2e_s").observe(req.e2e_s)
+
+    def _finish_slot(self, i: int, reason: str) -> None:
+        slot = self._active[i]
+        self._active[i] = None
+        slot.req.finish(reason)
+        self._account_finish(slot.req, reason)
+        self.registry.gauge("serve_active_slots").set(self.active_slots())
+
+    def _admit(self) -> bool:
+        """Admit waiting requests into free slots and prefill them,
+        grouped by bucket so each group is one device call."""
+        free = [i for i, s in enumerate(self._active) if s is None]
+        if not free:
+            return False
+        reqs = self.queue.pop_ready(len(free))
+        self.registry.gauge("serve_queue_depth").set(self.queue.depth())
+        if not reqs:
+            return False
+        by_bucket = {}
+        for req, slot_i in zip(reqs, free):
+            by_bucket.setdefault(self.bucket_for(req.prompt.size),
+                                 []).append((slot_i, req))
+        for bucket, group in sorted(by_bucket.items()):
+            self._prefill(bucket, group)
+        self.registry.gauge("serve_active_slots").set(self.active_slots())
+        return True
+
+    def _prefill(self, bucket: int, group) -> None:
+        """One chunked-prefill device call for every admitted request
+        padded to this bucket; K/V land in each slot's cache row and
+        the first token is sampled from the last REAL prompt position.
+        The padded tail writes garbage K/V beyond the prompt — masked
+        invariant: a decode query at position p attends only j <= p and
+        overwrites position p first, so padding is never visible."""
+        from tpunet.obs.spans import span
+
+        t0 = time.perf_counter()
+        toks = np.zeros((self.slots, bucket), np.int32)
+        active = np.zeros((self.slots,), bool)
+        for slot_i, req in group:
+            toks[slot_i, :req.prompt.size] = req.prompt
+            active[slot_i] = True
+            # Slot the request BEFORE the device call: if the step
+            # raises, the engine's failure handler finds (and fails)
+            # it in _active instead of stranding a popped request.
+            self._active[slot_i] = _Slot(req, pos=req.prompt.size,
+                                         next_token=0)
+        positions = np.zeros((self.slots,), np.int32)
+        with span("tpunet/serve_prefill"):
+            self._cache, logits = self._step(
+                self.variables["params"], self._cache, toks, positions,
+                active)
+            logits = np.asarray(logits)
+        reg = self.registry
+        for slot_i, req in group:
+            n = req.prompt.size
+            first = sample_token(logits[slot_i, n - 1], req)
+            self._active[slot_i].next_token = first
+            req.push_token(first)
+            reg.counter("serve_tokens_total").inc()
+            reg.histogram("serve_ttft_s").observe(req.ttft_s)
+            self._slot_maybe_finish(slot_i, first)
+        reg.counter("serve_prefills_total").inc()
+        reg.counter("serve_prefill_tokens_total").inc(
+            sum(r.prompt.size for _, r in group))
+        reg.histogram("serve_prefill_s").observe(
+            time.perf_counter() - t0)
+
+    def _slot_maybe_finish(self, slot_i: int, token: int) -> bool:
+        """Stop checks after a sampled token; True when the slot was
+        freed."""
+        slot = self._active[slot_i]
+        req = slot.req
+        if req.stop_token is not None and token == req.stop_token:
+            self._finish_slot(slot_i, FINISH_STOP)
+            return True
+        if slot.generated >= req.max_new_tokens \
+                or slot.pos + 1 > self.max_seq_len:
+            self._finish_slot(slot_i, FINISH_LENGTH)
+            return True
+        return False
+
+    def _decode_iteration(self) -> bool:
+        """One masked decode step across the whole pool: every active
+        slot consumes its pending token at its own position and samples
+        the next one."""
+        live = [(i, s) for i, s in enumerate(self._active)
+                if s is not None]
+        if not live:
+            return False
+        from tpunet.obs.spans import span
+
+        t0 = time.perf_counter()
+        toks = self._inactive_tok.copy()
+        positions = np.zeros((self.slots,), np.int32)
+        active = np.zeros((self.slots,), bool)
+        for i, slot in live:
+            toks[i, 0] = slot.next_token
+            positions[i] = slot.pos
+            active[i] = True
+        with span("tpunet/serve_decode"):
+            self._cache, logits = self._step(
+                self.variables["params"], self._cache, toks, positions,
+                active)
+            logits = np.asarray(logits)
+        lap = time.perf_counter() - t0
+        reg = self.registry
+        reg.counter("serve_decode_steps_total").inc()
+        reg.histogram("serve_decode_iter_s").observe(lap)
+        # per-token latency: the iteration produced one token for each
+        # live slot, each of which waited the full iteration.
+        reg.histogram("serve_token_s").observe(lap)
+        for i, slot in live:
+            nxt = sample_token(logits[i, 0], slot.req)
+            slot.pos += 1
+            slot.next_token = nxt
+            slot.generated += 1
+            slot.req.push_token(nxt)
+            reg.counter("serve_tokens_total").inc()
+            self._slot_maybe_finish(i, nxt)
+        return True
+
+    # -- obs -------------------------------------------------------------
+
+    def _emit_record(self, final: bool = False) -> None:
+        """One ``obs_serve`` record (docs/metrics_schema.md) per window:
+        cumulative counters + window histograms, then a fresh window."""
+        reg = self.registry
+        now = time.perf_counter()
+        window = now - self._last_emit
+        self._last_emit = now
+        record = {
+            "uptime_s": round(now - self._started, 3),
+            "window_s": round(window, 3),
+            "queue_depth": self.queue.depth(),
+            "active_slots": self.active_slots(),
+            "slots": self.slots,
+            "requests_total": int(
+                reg.counter("serve_requests_total").value),
+            "requests_completed": int(
+                reg.counter("serve_requests_completed").value),
+            "requests_rejected": int(
+                reg.counter("serve_requests_rejected").value),
+            "tokens_total": int(reg.counter("serve_tokens_total").value),
+            "decode_steps_total": int(
+                reg.counter("serve_decode_steps_total").value),
+            "prefills_total": int(
+                reg.counter("serve_prefills_total").value),
+        }
+        for name, key in (("serve_ttft_s", "ttft"),
+                          ("serve_token_s", "token_latency"),
+                          ("serve_e2e_s", "e2e"),
+                          ("serve_prefill_s", "prefill")):
+            summ = reg.histogram(name).summary()
+            for stat in ("p50", "p90", "p99", "mean", "count"):
+                if stat in summ:
+                    record[f"{key}_{stat}_s" if stat != "count"
+                           else f"{key}_count"] = (
+                        round(summ[stat], 6) if stat != "count"
+                        else int(summ[stat]))
+        if final:
+            record["final"] = True
+        reg.emit("obs_serve", record)
+        reg.reset_window()
