@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Schema-conformance check: no record field leaves the code
+undocumented.
+
+docs/metrics_schema.md is the contract between the trainer/server/
+aggregator and every consumer — but nothing used to enforce it, and
+fields drifted in silently (the PR-3 obs_serve kind shipped fields the
+doc didn't know). This script closes the loop from the emitting side:
+it drives every obs / serve / agg record-emission path against an
+in-memory sink (no run, no devices — CPU jax only), then asserts that
+every emitted ``kind`` and every top-level field is documented in the
+schema file. The check is one-directional on purpose: the doc may
+describe more than one run emits (fields are often conditional), but
+the code may never emit what the doc doesn't describe.
+
+Run standalone (exit 1 on drift, listing the offenders), or through
+the non-slow ``tests/test_schema_conformance.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "..",
+                           "docs", "metrics_schema.md")
+
+# The kind assigned to records with no "kind" field (plain epoch rows).
+PLAIN = "<plain>"
+
+
+# ---------------------------------------------------------------------------
+# doc side: parse documented kinds and field names
+# ---------------------------------------------------------------------------
+
+
+def _expand_braces(text: str):
+    """``ttft_{p50,p90}_s`` -> ttft_p50_s, ttft_p90_s (one level)."""
+    m = re.search(r"\{([^{}]*)\}", text)
+    if not m:
+        yield text
+        return
+    for alt in m.group(1).split(","):
+        yield from _expand_braces(text[:m.start()] + alt.strip()
+                                  + text[m.end():])
+
+
+def _span_tokens(span: str):
+    """Field-name tokens inside one backticked span."""
+    for expanded in _expand_braces(span):
+        for tok in re.findall(r"[A-Za-z_][A-Za-z0-9_]*", expanded):
+            yield tok
+
+
+def parse_schema(path: str = SCHEMA_PATH):
+    """-> (kinds, fields_by_kind, global_fields). Field sets are the
+    union of identifier tokens in the section's code spans — a
+    deliberate superset (prose code spans add stray tokens), since the
+    check only runs emitted ⊆ documented."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    kinds: set = set()
+    fields: dict = {}
+    global_fields: set = set()
+    current = None          # a kind, "GLOBAL", or None
+    for line in lines:
+        if line.startswith("## "):
+            current = None
+            m = re.match(r"##\s+`([a-z_]+)`", line)
+            if m:
+                current = m.group(1)
+                kinds.add(current)
+                fields.setdefault(current, set())
+            elif "Plain epoch record" in line:
+                current = PLAIN
+                kinds.add(PLAIN)
+                fields.setdefault(PLAIN, set())
+            elif "Run identity" in line:
+                # Identity fields are stamped on EVERY kind.
+                current = "GLOBAL"
+            continue
+        if current is None:
+            continue
+        dest = global_fields if current == "GLOBAL" else fields[current]
+        for span in re.findall(r"`([^`]+)`", line):
+            dest.update(_span_tokens(span))
+    return kinds, fields, global_fields
+
+
+# ---------------------------------------------------------------------------
+# code side: drive every emission path into a MemorySink
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def collect_obs_records(tmpdir: str) -> list:
+    """obs_epoch / obs_step / obs_alert (every watchdog reason) via
+    the real Observability facade."""
+    import dataclasses
+
+    from tpunet.config import ObsConfig
+    from tpunet.obs import Observability
+    from tpunet.obs.health import Watchdog
+    from tpunet.obs.registry import MemorySink
+
+    cfg = ObsConfig(step_records_every=1)
+    obs = Observability(cfg, checkpoint_dir=tmpdir)
+    sink = MemorySink()
+    obs.add_sink(sink)
+    obs.set_flops_per_unit(1e6)
+    obs.begin_epoch(1)
+    for step in range(1, 4):
+        obs.observe_data_wait(0.002)
+        obs.observe_step(step, 0.01 + 0.001 * step)
+        obs.observe_loss(step, 1.0)
+    obs.registry.counter("ckpt_saves").inc()
+    obs.registry.counter("ckpt_wait_s").inc(0.5)
+    obs.end_epoch(epoch=1, step=3, units=300.0, train_seconds=0.05,
+                  eval_seconds=0.01, partial=True)
+    obs.close()
+
+    # Watchdog: drive every alert reason with an injected clock.
+    clock = _FakeClock()
+    wcfg = dataclasses.replace(
+        cfg, stall_factor=2.0, stall_min_s=0.0, loss_spike_factor=2.0,
+        heartbeat_timeout_s=10.0, alert_cooldown_steps=0,
+        gauge_rules=("some_gauge > 1", "some_gauge + 0.1/s"))
+    from tpunet.obs.registry import Registry
+    reg = Registry()
+    reg.set_identity(run_id="check", process_index=0, host="h")
+    reg.add_sink(sink)
+    wd = Watchdog(wcfg, reg, expected_processes=2, clock=clock)
+    for i in range(Watchdog.MIN_BASELINE):
+        wd.observe_step(i, 0.01)
+    wd.observe_step(20, 1.0)                      # step_stall
+    wd.observe_loss(21, float("nan"))             # nan_loss
+    for i in range(Watchdog.MIN_LOSS_OBS + 1):
+        wd.observe_loss(22 + i, 1.0)
+    wd.observe_loss(40, 100.0)                    # loss_spike
+    clock.t += 100.0
+    wd.check_heartbeat(step=41)                   # stale_heartbeat
+    wd.observe_heartbeat(live=1, step=42)         # missing_processes
+    reg.gauge("some_gauge").set(5.0)
+    wd.check_gauges(43, reg.snapshot())           # threshold rule
+    for i in range(4):                            # growth rule
+        reg.gauge("some_gauge").set(5.0 + i)
+        clock.t += 1.0
+        wd.check_gauges(44 + i, reg.snapshot())
+    return sink.records
+
+
+def collect_serve_records() -> list:
+    """obs_serve via the factored record builder (no engine/model
+    needed — the builder IS the record shape)."""
+    from tpunet.obs.registry import MemorySink, Registry
+    from tpunet.serve.engine import build_serve_record
+
+    reg = Registry()
+    reg.set_identity(run_id="serve-check", process_index=0, host="h")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    for name in ("serve_requests_total", "serve_requests_completed",
+                 "serve_requests_rejected", "serve_tokens_total",
+                 "serve_decode_steps_total", "serve_prefills_total"):
+        reg.counter(name).inc(3)
+    for name in ("serve_ttft_s", "serve_token_s", "serve_e2e_s",
+                 "serve_prefill_s"):
+        for i in range(5):
+            reg.histogram(name).observe(0.01 * (i + 1))
+    record = build_serve_record(
+        reg, queue_depth=1, active_slots=2, slots=4,
+        uptime_s=12.0, window_s=3.0, final=True)
+    reg.emit("obs_serve", record)
+    return sink.records
+
+
+def collect_agg_records() -> list:
+    """obs_fleet + every fleet obs_alert reason via a two-stream
+    aggregator (one straggling, one leaking, both serving)."""
+    from tpunet.obs.agg import Aggregator
+    from tpunet.obs.registry import MemorySink
+
+    clock = _FakeClock()
+    agg = Aggregator(clock=clock, straggler_factor=1.5,
+                     stream_stale_s=5.0,
+                     mem_growth_bytes_per_epoch=1.0,
+                     rules=("serve_queue_depth > 0",
+                            "step_time_p50_s + 1e-9/s"))
+    sink = MemorySink()
+    agg.registry.add_sink(sink)
+    for name, base in (("a", 0.01), ("b", 0.05)):
+        for ep in range(1, 5):
+            sample = [base + 0.0001 * i for i in range(16)]
+            agg.ingest({
+                "kind": "obs_epoch", "run_id": name,
+                "process_index": 0, "host": name, "epoch": ep,
+                "step": 10 * ep, "steps": 16,
+                "step_time_mean_s": base, "step_time_p50_s": base,
+                "step_time_sample": sample, "tokens_per_sec": 100.0,
+                "mfu": 0.4, "live_processes": 1,
+                "device_memory": [
+                    {"device": 0,
+                     "peak_bytes_in_use": 2 ** 20 + ep * 100}],
+            })
+            for s in range(10 * ep - 2, 10 * ep):
+                agg.ingest({"kind": "obs_step", "run_id": name,
+                            "process_index": 0, "step": s,
+                            "step_time_s": base})
+        agg.ingest({
+            "kind": "obs_serve", "run_id": f"serve-{name}",
+            "process_index": 0, "host": name, "uptime_s": 9.0,
+            "window_s": 3.0, "queue_depth": 2, "active_slots": 1,
+            "slots": 4, "requests_total": 10, "requests_completed": 8,
+            "requests_rejected": 1, "tokens_total": 100,
+            "ttft_count": 8, "ttft_p50_s": 0.05,
+            "ttft_sample": [0.04 + 0.001 * i for i in range(8)],
+            "e2e_count": 8, "e2e_p99_s": 0.9,
+            "e2e_sample": [0.8 + 0.01 * i for i in range(8)],
+        })
+        agg.ingest({"kind": "obs_alert", "run_id": name,
+                    "process_index": 0, "reason": "step_stall",
+                    "step": 5, "severity": "warn"})
+    agg.emit_rollup()           # straggler + mem_growth + rules
+    clock.t += 100.0
+    agg.emit_rollup()           # stream_stale for every stream
+    return sink.records
+
+
+# ---------------------------------------------------------------------------
+
+
+def undocumented(records, kinds, fields, global_fields) -> list:
+    bad = set()
+    for r in records:
+        kind = r.get("kind", PLAIN)
+        if kind not in kinds:
+            bad.add((kind, "<kind undocumented>"))
+            continue
+        allowed = fields[kind] | global_fields | {"kind"}
+        for f in r:
+            if f not in allowed:
+                bad.add((kind, f))
+    return sorted(bad)
+
+
+def main() -> int:
+    import tempfile
+
+    kinds, fields, global_fields = parse_schema()
+    records = []
+    with tempfile.TemporaryDirectory() as tmp:
+        records += collect_obs_records(tmp)
+    records += collect_serve_records()
+    records += collect_agg_records()
+    emitted_kinds = sorted({r.get("kind", PLAIN) for r in records})
+    bad = undocumented(records, kinds, fields, global_fields)
+    if bad:
+        print("schema drift: emitted but not documented in "
+              "docs/metrics_schema.md:", file=sys.stderr)
+        for kind, field in bad:
+            print(f"  kind={kind!r:<14} field={field!r}",
+                  file=sys.stderr)
+        return 1
+    print(f"schema OK: {len(records)} records across kinds "
+          f"{emitted_kinds} all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

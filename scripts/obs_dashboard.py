@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Live terminal dashboard over the tpunet obs record stream.
 
-Three input modes, one renderer (``tpunet.obs.summary.summarize`` —
+Single-run modes, one renderer (``tpunet.obs.summary.summarize`` —
 the same summarizer ``obs_report.py`` uses, so live and post-mortem
 views can never disagree):
 
@@ -16,12 +16,25 @@ views can never disagree):
     #   train.py --obs-http http://HOST:8321/
     python scripts/obs_dashboard.py --listen 8321
 
+Fleet mode (``tpunet.obs.agg``) merges N streams into one view —
+give several metrics.jsonl paths (tailed/replayed side by side), or
+``--listen --fleet`` to route concurrent POSTs from many runs by
+their ``run_id``/``process_index`` identity stamps:
+
+    python scripts/obs_dashboard.py runA/ runB/ --once --html fleet.html
+    python scripts/obs_dashboard.py --listen 8321 --fleet --stale-after 60
+
+The fleet view shows exact merged counts/means, bounded-error merged
+percentiles, the step-aligned straggler factor, per-stream rows, the
+aggregated serve SLO panel (fleet TTFT/e2e, total queue depth,
+per-replica reject rates), and fleet alerts (straggler / stale stream
+/ memory growth / ``--rule`` GaugePredicates).
+
 ``--html report.html`` writes a self-contained static report (stat
-tiles, per-epoch throughput and step-time-trend SVG charts, alert and
-epoch tables; light/dark via CSS custom properties) instead of — or,
-in follow mode, alongside — the terminal view. GET on the ``--listen``
-port returns the current text render, so ``curl :8321`` is a remote
-status line.
+tiles, SVG charts, alert and epoch tables; light/dark via CSS custom
+properties) instead of — or, in follow mode, alongside — the terminal
+view. GET on the ``--listen`` port returns the current text render,
+so ``curl :8321`` is a remote status line.
 """
 
 from __future__ import annotations
@@ -153,12 +166,14 @@ body {
   margin: 0; padding: 24px; background: #fcfcfb; color: #0b0b0b;
   font: 14px/1.5 system-ui, -apple-system, sans-serif;
   --surface: #fcfcfb; --text-2: #52514e; --grid: #e8e7e3;
-  --s1: #2a78d6; --s2: #eb6834; --bad: #e34948;
+  --s1: #2a78d6; --s2: #eb6834; --s3: #7a57c9; --s4: #177c70;
+  --s5: #a84f93; --bad: #e34948;
 }
 @media (prefers-color-scheme: dark) {
   body { background: #1a1a19; color: #fff;
          --surface: #1a1a19; --text-2: #c3c2b7; --grid: #343431;
-         --s1: #3987e5; --s2: #d95926; --bad: #e66767; }
+         --s1: #3987e5; --s2: #d95926; --s3: #9678db; --s4: #2b9486;
+         --s5: #c36bad; --bad: #e66767; }
 }
 h1 { font-size: 18px; margin: 0 0 4px; }
 .sub { color: var(--text-2); margin: 0 0 20px; }
@@ -344,6 +359,217 @@ def render_html(summary: dict, source: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# fleet view (tpunet.obs.agg)
+# ---------------------------------------------------------------------------
+
+_SERIES = ("--s1", "--s2", "--s3", "--s4", "--s5")
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{v * 1e3:.1f}"
+
+
+def render_fleet_terminal(rollup: dict, ages: dict, source: str,
+                          alerts=()) -> str:
+    """One text frame of the fleet rollup + per-stream table."""
+    out = [f"tpunet fleet dashboard — {source} — "
+           f"{time.strftime('%H:%M:%S')}"]
+    head = [f"streams {rollup.get('streams', 0)}"]
+    unit = rollup.get("throughput_unit")
+    if unit:
+        head.append(f"{_fmt_rate(rollup[f'{unit}_per_sec'])} "
+                    f"{'tok/s' if unit == 'tokens' else 'ex/s'} total")
+    if rollup.get("step_time_p50_s") is not None:
+        head.append(f"fleet p50 {_ms(rollup['step_time_p50_s'])}ms "
+                    f"p99 {_ms(rollup.get('step_time_p99_s'))}ms "
+                    f"(±{rollup.get('step_time_rank_err', 0):.3f} rank)")
+    if rollup.get("straggler_factor") is not None:
+        head.append(f"straggler x{rollup['straggler_factor']:.2f}")
+    if rollup.get("serve_queue_depth") is not None:
+        head.append(f"queue {rollup['serve_queue_depth']}")
+    out.append("  ".join(head))
+    out.append("")
+
+    if alerts:
+        out.append(f"FLEET ALERTS ({len(alerts)}):")
+        for a in alerts[-5:]:
+            out.append(f"  [{a.get('scope', '?'):>6}] "
+                       f"{a.get('reason', '?')} "
+                       f"{a.get('stream', '')}")
+        out.append("")
+
+    rows = rollup.get("per_stream", [])
+    if rows:
+        out.append(f"{'stream':<24} {'ep':>4} {'step':>8} {'p50ms':>8} "
+                   f"{'thruput':>9} {'mfu':>6} {'age s':>6}")
+        for r in rows:
+            t = r.get("tokens_per_sec", r.get("examples_per_sec"))
+            mfu = r.get("mfu")
+            age = ages.get(r["stream"])
+            out.append(
+                f"{r['stream']:<24.24} {r.get('epoch', '-'):>4} "
+                f"{r.get('step', '-'):>8} "
+                f"{_ms(r.get('step_time_p50_s')):>8} "
+                f"{_fmt_rate(t):>9} "
+                f"{'-' if mfu is None else f'{mfu:6.3f}'} "
+                f"{'-' if age is None else f'{age:6.1f}'}")
+        out.append("")
+
+    if rollup.get("serve_replicas"):
+        out.append(
+            f"serve: {rollup['serve_replicas']} replicas  "
+            f"queue {rollup.get('serve_queue_depth', 0)}  "
+            f"slots {rollup.get('serve_active_slots', 0)}"
+            f"/{rollup.get('serve_slots', 0)}  "
+            f"reject {100 * rollup.get('serve_reject_rate', 0.0):.2f}%")
+        if rollup.get("serve_ttft_p50_s") is not None:
+            out.append(
+                f"  TTFT p50 {_ms(rollup['serve_ttft_p50_s'])}ms "
+                f"p99 {_ms(rollup.get('serve_ttft_p99_s'))}ms   "
+                f"e2e p50 {_ms(rollup.get('serve_e2e_p50_s'))}ms "
+                f"p99 {_ms(rollup.get('serve_e2e_p99_s'))}ms")
+    if len(out) <= 3:
+        out.append("waiting for records...")
+    return "\n".join(out)
+
+
+def render_fleet_html(rollup: dict, streams, source: str,
+                      alerts=()) -> str:
+    """Static fleet report: rollup tiles, per-stream step-time chart,
+    per-stream table, serve SLO panel, fleet alert table."""
+    e = html_mod.escape
+    tiles = []
+
+    def tile(value, key):
+        tiles.append(f'<div class="tile"><div class="v">{e(str(value))}'
+                     f'</div><div class="k">{e(key)}</div></div>')
+
+    tile(rollup.get("streams", 0), "streams")
+    unit = rollup.get("throughput_unit")
+    if unit:
+        tile(_fmt_rate(rollup[f"{unit}_per_sec"]),
+             "tokens/s total" if unit == "tokens" else "examples/s total")
+    if rollup.get("step_time_p50_s") is not None:
+        tile(f"{_ms(rollup['step_time_p50_s'])} ms", "fleet step p50")
+        tile(f"{_ms(rollup.get('step_time_p99_s'))} ms",
+             f"fleet step p99 (±{rollup.get('step_time_rank_err', 0):.3f})")
+    if rollup.get("straggler_factor") is not None:
+        tile(f"x{rollup['straggler_factor']:.2f}", "straggler factor")
+    if rollup.get("step_lag") is not None:
+        tile(rollup["step_lag"], "step lag")
+    tile(rollup.get("alerts_total", 0) + len(alerts), "alerts")
+
+    cards = []
+    # Per-stream step-time trend: one line per stream, shared y scale.
+    series = []
+    legend = []
+    for i, s in enumerate(streams):
+        pts = [(ep, p * 1e3)
+               for ep, p in list(getattr(s, "epoch_p50s", []))]
+        if not pts:
+            continue
+        color = _SERIES[i % len(_SERIES)]
+        series.append((color, s.key, pts))
+        legend.append(f'<span class="sw" style="background:var({color})">'
+                      f"</span>{e(s.key)}")
+    if series:
+        chart = _svg_line_chart(series, fmt=lambda v: f"{v:.1f}ms")
+        cards.append('<div class="card"><h2>Step time p50 per epoch, '
+                     'per stream</h2><div class="legend">'
+                     + "&nbsp;&nbsp;".join(legend) + "</div>"
+                     + chart + "</div>")
+
+    rows = rollup.get("per_stream", [])
+    if rows:
+        body = []
+        for r in rows:
+            t = r.get("tokens_per_sec", r.get("examples_per_sec"))
+            mfu = r.get("mfu")
+            body.append(
+                f"<tr><td>{e(str(r['stream']))}</td>"
+                f"<td>{e(str(r.get('host', '-')))}</td>"
+                f"<td>{r.get('epoch', '-')}</td>"
+                f"<td>{r.get('step', '-')}</td>"
+                f"<td>{_ms(r.get('step_time_p50_s'))}</td>"
+                f"<td>{'-' if t is None else _fmt_rate(t)}</td>"
+                f"<td>{'-' if mfu is None else f'{mfu:.3f}'}</td>"
+                f"<td>{r.get('alerts', 0)}</td></tr>")
+        cards.append('<div class="card"><h2>Streams</h2><table>'
+                     "<tr><th>stream</th><th>host</th><th>ep</th>"
+                     "<th>step</th><th>p50 ms</th><th>thruput</th>"
+                     "<th>mfu</th><th>alerts</th></tr>"
+                     + "".join(body) + "</table></div>")
+
+    if rollup.get("serve_replicas"):
+        sv_tiles = []
+
+        def sv_tile(value, key):
+            sv_tiles.append(
+                f'<div class="tile"><div class="v">{e(str(value))}'
+                f'</div><div class="k">{e(key)}</div></div>')
+
+        sv_tile(rollup["serve_replicas"], "replicas")
+        sv_tile(rollup.get("serve_queue_depth", 0), "total queue depth")
+        sv_tile(f"{rollup.get('serve_active_slots', 0)}"
+                f"/{rollup.get('serve_slots', 0)}", "active slots")
+        if rollup.get("serve_ttft_p50_s") is not None:
+            sv_tile(f"{_ms(rollup['serve_ttft_p50_s'])} ms", "fleet TTFT p50")
+            sv_tile(f"{_ms(rollup.get('serve_ttft_p99_s'))} ms",
+                    f"fleet TTFT p99 "
+                    f"(±{rollup.get('serve_ttft_rank_err', 0):.3f})")
+        if rollup.get("serve_e2e_p99_s") is not None:
+            sv_tile(f"{_ms(rollup['serve_e2e_p99_s'])} ms", "fleet e2e p99")
+        sv_tile(f"{100 * rollup.get('serve_reject_rate', 0.0):.2f}%",
+                "reject rate")
+        body = []
+        for r in rows:
+            if r.get("serve_requests_total") is None:
+                continue
+            body.append(
+                f"<tr><td>{e(str(r['stream']))}</td>"
+                f"<td>{r.get('serve_queue_depth', 0)}</td>"
+                f"<td>{r.get('serve_active_slots', 0)}"
+                f"/{r.get('serve_slots', 0)}</td>"
+                f"<td>{r.get('serve_requests_total', 0)}</td>"
+                f"<td>{100 * r.get('serve_reject_rate', 0.0):.2f}%</td>"
+                f"<td>{_ms(r.get('serve_ttft_p50_s'))}</td>"
+                f"<td>{_ms(r.get('serve_e2e_p99_s'))}</td></tr>")
+        table = ""
+        if body:
+            table = ("<table><tr><th>replica</th><th>queue</th>"
+                     "<th>slots</th><th>requests</th><th>reject</th>"
+                     "<th>ttft p50 ms</th><th>e2e p99 ms</th></tr>"
+                     + "".join(body) + "</table>")
+        cards.append('<div class="card"><h2>Serve SLO (fleet)</h2>'
+                     f'<div class="tiles">{"".join(sv_tiles)}</div>'
+                     + table + "</div>")
+
+    if alerts:
+        body = "".join(
+            f'<tr class="alert"><td>{e(str(a.get("reason", "?")))}</td>'
+            f'<td>{e(str(a.get("scope", "?")))}</td>'
+            f'<td>{e(str(a.get("stream", "")))}</td>'
+            f'<td style="text-align:left">'
+            f'{e(json.dumps({k: v for k, v in a.items() if k not in ("kind", "reason", "scope", "stream", "severity", "step", "run_id", "process_index", "host")}))}'
+            f"</td></tr>" for a in alerts)
+        cards.append('<div class="card"><h2>Fleet alerts</h2><table>'
+                     "<tr><th>reason</th><th>scope</th><th>stream</th>"
+                     '<th style="text-align:left">detail</th></tr>'
+                     + body + "</table></div>")
+
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            "<meta name='viewport' content='width=device-width,"
+            "initial-scale=1'>"
+            f"<title>tpunet fleet — {e(source)}</title>"
+            f"<style>{_CSS}</style></head><body>"
+            f"<h1>tpunet fleet observability report</h1>"
+            f'<p class="sub">{e(source)} — generated '
+            f"{time.strftime('%Y-%m-%d %H:%M:%S')}</p>"
+            f'<div class="tiles">{"".join(tiles)}</div>'
+            + "".join(cards) + "</body></html>")
+
+
+# ---------------------------------------------------------------------------
 # record sources: file tail / HTTP listener
 # ---------------------------------------------------------------------------
 
@@ -389,10 +615,14 @@ class RecordBuffer:
             return list(self._records)
 
 
-def serve_http(port: int, buf: RecordBuffer, source_name: str):
+def serve_http(port: int, buf: RecordBuffer, source_name: str,
+               agg=None):
     """Line-JSON ingest endpoint matching HttpLineTransport: POST
     bodies are newline-delimited records; GET returns the current
-    text render."""
+    text render. With ``agg`` (fleet mode) each record is also routed
+    into the aggregator by its identity stamp — N runs posting
+    concurrently become N streams (handler threads ingest
+    concurrently; the aggregator is thread-safe)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from tpunet.obs.summary import summarize
@@ -409,13 +639,25 @@ def serve_http(port: int, buf: RecordBuffer, source_name: str):
                     records.append(json.loads(line))
                 except json.JSONDecodeError:
                     pass    # one bad line must not poison the stream
-            buf.feed(records)
+            if agg is not None:
+                # Fleet mode renders from the aggregator only; also
+                # filling the buffer would grow an unrendered list of
+                # non-step records without bound.
+                agg.ingest_many(
+                    records, source=self.client_address[0])
+            else:
+                buf.feed(records)
             self.send_response(204)
             self.end_headers()
 
         def do_GET(self):
-            text = render_terminal(summarize(buf.snapshot()),
-                                   source_name)
+            if agg is not None:
+                text = render_fleet_terminal(
+                    agg.rollup(), agg.heartbeat_ages(), source_name,
+                    alerts=agg.bridge.alerts)
+            else:
+                text = render_terminal(summarize(buf.snapshot()),
+                                       source_name)
             data = (text + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type",
@@ -438,9 +680,10 @@ def serve_http(port: int, buf: RecordBuffer, source_name: str):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", nargs="?",
-                    help="metrics.jsonl or a directory containing one; "
-                         "omit with --listen")
+    ap.add_argument("path", nargs="*",
+                    help="metrics.jsonl (or a directory containing "
+                         "one); several paths = fleet mode; omit with "
+                         "--listen")
     ap.add_argument("--listen", type=int, metavar="PORT",
                     help="receive line-JSON POSTs (train.py "
                          "--obs-http http://HOST:PORT/) instead of "
@@ -454,64 +697,126 @@ def main(argv=None) -> int:
                          "(re-written every refresh in follow mode)")
     ap.add_argument("--last", type=int, default=10,
                     help="epochs shown in the terminal table")
+    ap.add_argument("--fleet", action="store_true",
+                    help="aggregate --listen streams by run identity "
+                         "(automatic when several paths are given)")
+    ap.add_argument("--straggler-factor", type=float, default=2.0,
+                    help="fleet straggler alert: slowest stream's step "
+                         "time above FACTOR x the median of the rest")
+    ap.add_argument("--stale-after", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="fleet stream_stale alert when a live stream "
+                         "stops posting for this long (0 = off)")
+    ap.add_argument("--mem-growth", type=float, default=0.0,
+                    metavar="BYTES_PER_EPOCH",
+                    help="fleet mem_growth alert when any stream's "
+                         "peak device bytes grow faster than this per "
+                         "epoch (0 = off)")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="RULE",
+                    help="GaugePredicate rule evaluated fleet-wide AND "
+                         "per stream (e.g. 'serve_queue_depth > 10'); "
+                         "repeatable")
     args = ap.parse_args(argv)
 
-    if (args.path is None) == (args.listen is None):
-        ap.error("give a metrics.jsonl path OR --listen PORT")
+    if bool(args.path) == (args.listen is not None):
+        ap.error("give metrics.jsonl path(s) OR --listen PORT")
 
     from tpunet.obs.summary import summarize
     from tpunet.utils.logging import MetricsLogger
 
+    paths = []
+    for p in args.path:
+        if os.path.isdir(p):
+            p = os.path.join(p, "metrics.jsonl")
+        paths.append(p)
+    fleet = args.fleet or len(paths) > 1
+
+    agg = None
+    if fleet:
+        from tpunet.obs.agg import Aggregator
+        agg = Aggregator(straggler_factor=args.straggler_factor,
+                         stream_stale_s=args.stale_after,
+                         mem_growth_bytes_per_epoch=args.mem_growth,
+                         rules=tuple(args.rule))
+
     buf = RecordBuffer()
-    path = None
-    offset = 0
+    offsets = {p: 0 for p in paths}
     if args.listen is not None:
         source = f"http://:{args.listen}"
-        serve_http(args.listen, buf, source)
+        serve_http(args.listen, buf, source, agg=agg)
     else:
-        path = args.path
-        if os.path.isdir(path):
-            path = os.path.join(path, "metrics.jsonl")
-        source = path
-        if args.once and not os.path.isfile(path):
-            print(f"no metrics.jsonl at {path}", file=sys.stderr)
-            return 1
+        source = paths[0] if len(paths) == 1 else f"{len(paths)} streams"
+        if args.once:
+            missing = [p for p in paths if not os.path.isfile(p)]
+            if missing:
+                print(f"no metrics.jsonl at {', '.join(missing)}",
+                      file=sys.stderr)
+                return 1
 
     def refresh():
-        nonlocal offset
-        if path is not None:
-            records, offset, reset = MetricsLogger.tail_records(
-                path, offset)
+        for p in paths:
+            records, offsets[p], reset = MetricsLogger.tail_records(
+                p, offsets[p])
             if reset:
                 # Fresh run truncated the file underneath us: drop the
                 # old run's records (already re-read from the start),
                 # or every aggregate would straddle two runs.
+                if agg is not None:
+                    agg.drop_source(p)
                 buf.clear()
-            buf.feed(records)
+            if agg is not None:
+                # Follow-mode tailing IS live: stamp arrival so
+                # --stale-after can page a silent replica. Only a
+                # --once replay skips the clock (so replayed and
+                # concurrently-ingested rollups compare equal).
+                # Identity-less old files fall back to
+                # one-file-one-stream via the source tag.
+                agg.ingest_many(records, source=p,
+                                stamp_time=not args.once)
+            else:
+                buf.feed(records)
+        if agg is not None:
+            rollup = agg.rollup()
+            agg.bridge.check(rollup, agg.streams(),
+                             now=time.monotonic())
+            return rollup
         return summarize(buf.snapshot())
 
-    summary = refresh()
+    def render_text(view):
+        if agg is not None:
+            return render_fleet_terminal(view, agg.heartbeat_ages(),
+                                         source,
+                                         alerts=agg.bridge.alerts)
+        return render_terminal(view, source, last=args.last)
+
+    def render_page(view):
+        if agg is not None:
+            return render_fleet_html(view, agg.streams(), source,
+                                     alerts=agg.bridge.alerts)
+        return render_html(view, source)
+
+    view = refresh()
     if args.html:
         with open(args.html, "w") as f:
-            f.write(render_html(summary, source))
+            f.write(render_page(view))
     if args.once:
-        print(render_terminal(summary, source, last=args.last))
+        print(render_text(view))
         return 0
 
     try:
         while True:
             # Full-frame redraw: clear + home, like top(1).
             sys.stdout.write("\x1b[2J\x1b[H")
-            sys.stdout.write(render_terminal(summary, source,
-                                             last=args.last) + "\n")
+            sys.stdout.write(render_text(view) + "\n")
             sys.stdout.flush()
             if args.html:
                 tmp = args.html + ".tmp"
                 with open(tmp, "w") as f:
-                    f.write(render_html(summary, source))
+                    f.write(render_page(view))
                 os.replace(tmp, args.html)
             time.sleep(args.interval)
-            summary = refresh()
+            view = refresh()
     except KeyboardInterrupt:
         return 0
 

@@ -1,0 +1,450 @@
+"""Fleet aggregation (tpunet/obs/agg/): merge math with its error
+bound, live-concurrent vs offline-replay rollup equality, straggler /
+stale / growth alerting, and the dashboard's fleet mode end-to-end
+(HTTP multi-stream ingest and the two-file --html report)."""
+
+import json
+import os
+import random
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from tpunet.obs.agg import Aggregator, merge
+from tpunet.obs.registry import Histogram, MemorySink
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _import_dashboard():
+    sys.path.insert(0, SCRIPTS)
+    try:
+        return __import__("obs_dashboard")
+    finally:
+        sys.path.pop(0)
+
+
+def _epoch_record(run, epoch, laps, *, unit="tokens", thr=1000.0,
+                  peak=2 ** 30, count=None):
+    """An obs_epoch record the way the trainer builds one, from raw
+    laps through a real Histogram (sample export included)."""
+    h = Histogram()
+    for v in laps:
+        h.observe(v)
+    summ = h.summary()
+    rec = {
+        "kind": "obs_epoch", "run_id": run, "process_index": 0,
+        "host": f"host-{run}", "epoch": epoch, "step": 100 * epoch,
+        "steps": count if count is not None else summ["count"],
+        "train_seconds": 10.0,
+        "step_time_mean_s": summ["mean"],
+        "step_time_p50_s": summ["p50"],
+        "step_time_p90_s": summ["p90"],
+        "step_time_p99_s": summ["p99"],
+        "step_time_sample": h.export_sample(),
+        f"{unit}_per_sec": thr, "mfu": 0.5, "live_processes": 1,
+        "input_stall_s": 0.1, "stall_frac": 0.01,
+        "device_memory": [{"device": 0, "peak_bytes_in_use": peak}],
+    }
+    if summ.get("approx"):
+        rec["step_time_approx"] = 1
+    return rec
+
+
+def _serve_record(run, *, queue=2, rejected=0, total=100,
+                  ttft=0.05, e2e=0.9):
+    rng = random.Random(hash(run) & 0xFFFF)
+    return {
+        "kind": "obs_serve", "run_id": run, "process_index": 0,
+        "host": f"host-{run}", "uptime_s": 60.0, "window_s": 10.0,
+        "queue_depth": queue, "active_slots": 3, "slots": 8,
+        "requests_total": total, "requests_completed": total - rejected,
+        "requests_rejected": rejected, "tokens_total": 5000,
+        "ttft_count": 50, "ttft_p50_s": ttft,
+        "ttft_sample": sorted(ttft + rng.random() * 0.01
+                              for _ in range(50)),
+        "e2e_count": 50, "e2e_p50_s": e2e,
+        "e2e_sample": sorted(e2e + rng.random() * 0.1
+                             for _ in range(50)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# merge math
+# ---------------------------------------------------------------------------
+
+
+def test_merged_count_and_mean_are_exact():
+    # Exactness must hold even when the samples are lossy.
+    parts = [(5.0, 1000), (1.0, 3000)]
+    assert merge.merged_mean(parts) == pytest.approx(2.0)
+
+
+def test_merged_quantiles_single_full_stream_match_percentiles():
+    # One stream whose sample IS its window: the merge must agree
+    # with the histogram's own percentile definition.
+    rng = random.Random(7)
+    laps = [rng.random() for _ in range(200)]
+    h = Histogram()
+    for v in laps:
+        h.observe(v)
+    sample = h.export_sample()
+    merged = merge.merged_quantiles([(sample, len(laps), False)],
+                                    (50, 90, 99))
+    for q in (50, 90, 99):
+        assert merged[q] == pytest.approx(h.percentile(q), abs=5e-3)
+
+
+def test_merged_quantiles_within_documented_rank_bound():
+    """The acceptance property: merged quantiles of two lossy streams
+    sit within the documented rank-error bound of the ground-truth
+    combined distribution."""
+    rng = random.Random(42)
+    # Unequal sizes and disjoint-ish distributions — the hard case for
+    # naive percentile averaging.
+    a = [0.010 + rng.random() * 0.002 for _ in range(4000)]
+    b = [0.050 + rng.random() * 0.010 for _ in range(1000)]
+    parts = []
+    for data in (a, b):
+        h = Histogram(max_samples=512)      # force reservoir loss
+        for v in data:
+            h.observe(v)
+        parts.append((h.export_sample(), len(data), h.saturated))
+    bound = merge.rank_error_bound(parts)
+    assert 0 < bound < 0.2
+    truth = sorted(a + b)
+    n = len(truth)
+    merged = merge.merged_quantiles(parts, (50, 90, 99))
+    for q in (50, 90, 99):
+        est = merged[q]
+        # Empirical CDF of the true combined data at the estimate.
+        import bisect
+        rank = bisect.bisect_right(truth, est) / n
+        slack = bound + 1.0 / n   # interpolation half-step
+        assert abs(rank - q / 100.0) <= slack, (
+            f"p{q}: est {est:.6f} has true rank {rank:.4f}, "
+            f"outside ±{slack:.4f}")
+
+
+def test_rank_bound_tightens_with_sample_size():
+    small = merge.part_rank_error(16, True)
+    big = merge.part_rank_error(256, True)
+    assert big < small
+    # Unsaturated windows only pay export striding.
+    assert merge.part_rank_error(256, False) == pytest.approx(1 / 512)
+
+
+def test_histogram_export_sample_is_bounded_and_sorted():
+    h = Histogram()
+    rng = random.Random(3)
+    for _ in range(10_000):
+        h.observe(rng.random())
+    s = h.export_sample()
+    assert len(s) == Histogram.EXPORT_SAMPLE_MAX
+    assert s == sorted(s)
+    full = h.export_sample(max_n=100_000)
+    assert len(full) == len(h.values)
+
+
+# ---------------------------------------------------------------------------
+# aggregator: rollups, live-vs-replay equality, alerts
+# ---------------------------------------------------------------------------
+
+
+def _two_stream_records():
+    rng = random.Random(0)
+    by_stream = {}
+    for run, base in (("run-a", 0.01), ("run-b", 0.08)):
+        recs = []
+        for ep in range(1, 4):
+            laps = [base + rng.random() * 0.002 for _ in range(50)]
+            recs.append(_epoch_record(run, ep, laps,
+                                      peak=2 ** 30 + ep * 1000))
+            for s in range(100 * ep - 3, 100 * ep):
+                recs.append({"kind": "obs_step", "run_id": run,
+                             "process_index": 0, "step": s,
+                             "step_time_s": base})
+        recs.append(_serve_record(f"serve-{run}",
+                                  rejected=10 if run == "run-b" else 0))
+        by_stream[run] = recs
+    return by_stream
+
+
+def test_fleet_rollup_exact_merges_and_straggler_alert():
+    by_stream = _two_stream_records()
+    agg = Aggregator(straggler_factor=2.0)
+    sink = MemorySink()
+    agg.registry.add_sink(sink)
+    for recs in by_stream.values():
+        agg.ingest_many(recs, stamp_time=False)
+    rollup = agg.emit_rollup()
+
+    assert rollup["streams"] == 4          # 2 trainers + 2 serve
+    # Exact merged count and mean across both trainer streams.
+    assert rollup["steps_total"] == 300
+    expect_mean = sum(
+        r["step_time_mean_s"] * r["steps"]
+        for recs in by_stream.values() for r in recs
+        if r.get("kind") == "obs_epoch") / 300
+    # Exact up to the record's own 6-decimal rounding.
+    assert rollup["step_time_mean_s"] == pytest.approx(expect_mean,
+                                                       abs=1e-6)
+    assert rollup["tokens_per_sec"] == pytest.approx(2000.0)
+    # The inflated stream is named and the alert fired.
+    assert rollup["slowest_stream"] == "run-b/0"
+    assert rollup["straggler_factor"] > 2.0
+    reasons = [a["reason"] for a in agg.bridge.alerts]
+    assert "straggler" in reasons
+    alert = [a for a in agg.bridge.alerts
+             if a["reason"] == "straggler"][0]
+    assert alert["stream"] == "run-b/0"
+    assert alert["scope"] == "fleet"
+    # Alert reached the sinks as an obs_alert record.
+    assert any(r.get("reason") == "straggler"
+               for r in sink.by_kind("obs_alert"))
+    # Serve SLO rollup: sums and merged percentiles present.
+    assert rollup["serve_replicas"] == 2
+    assert rollup["serve_queue_depth"] == 4
+    assert rollup["serve_requests_rejected"] == 10
+    assert rollup["serve_reject_rate"] == pytest.approx(0.05)
+    assert 0.04 < rollup["serve_ttft_p50_s"] < 0.07
+    assert rollup["serve_ttft_rank_err"] > 0
+    # obs_fleet record emitted with the same content.
+    fleet = sink.by_kind("obs_fleet")
+    assert fleet and fleet[-1]["steps_total"] == 300
+
+
+def test_concurrent_ingest_and_offline_replay_agree(tmp_path):
+    """The acceptance property: two streams ingested concurrently
+    (threads, interleaved arbitrarily) and the same two record files
+    replayed offline produce the identical fleet rollup."""
+    by_stream = _two_stream_records()
+
+    live = Aggregator(straggler_factor=2.0)
+    threads = [threading.Thread(
+        target=lambda recs=recs: live.ingest_many(recs))
+        for recs in by_stream.values()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    replay = Aggregator(straggler_factor=2.0)
+    for run, recs in by_stream.items():
+        path = tmp_path / f"{run}.jsonl"
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        replay.replay_file(str(path))
+
+    assert live.rollup() == replay.rollup()
+    # Both fire the same alerts (deterministic, order-independent).
+    live.bridge.check(live.rollup(), live.streams())
+    replay.bridge.check(replay.rollup(), replay.streams())
+    strip = (lambda alerts: sorted(
+        (a["reason"], a.get("stream", "")) for a in alerts))
+    assert strip(live.bridge.alerts) == strip(replay.bridge.alerts)
+
+
+def test_alert_latch_fires_once_and_rearms():
+    agg = Aggregator(straggler_factor=2.0)
+    slow = _epoch_record("b", 1, [0.08] * 20)
+    fast = _epoch_record("a", 1, [0.01] * 20)
+    agg.ingest_many([fast, slow], stamp_time=False)
+    agg.emit_rollup()
+    agg.emit_rollup()       # condition persists: no re-page
+    assert [a["reason"] for a in agg.bridge.alerts] == ["straggler"]
+    # Condition clears (the slow stream recovers), then degrades again
+    # -> one new page.
+    agg.ingest(_epoch_record("b", 2, [0.011] * 20), stamp_time=False)
+    agg.emit_rollup()
+    agg.ingest(_epoch_record("b", 3, [0.09] * 20), stamp_time=False)
+    agg.emit_rollup()
+    assert [a["reason"] for a in agg.bridge.alerts] == ["straggler",
+                                                        "straggler"]
+
+
+def test_stream_stale_alert_uses_injected_clock():
+    clock = [100.0]
+    agg = Aggregator(clock=lambda: clock[0], stream_stale_s=30.0)
+    agg.ingest(_epoch_record("a", 1, [0.01] * 5))
+    agg.ingest(_epoch_record("b", 1, [0.01] * 5))
+    agg.emit_rollup()
+    assert not agg.bridge.alerts
+    clock[0] += 31.0
+    agg.ingest(_epoch_record("a", 2, [0.01] * 5))   # a stays live
+    agg.emit_rollup()
+    stale = [a for a in agg.bridge.alerts
+             if a["reason"] == "stream_stale"]
+    assert [a["stream"] for a in stale] == ["b/0"]
+
+
+def test_mem_growth_alert_names_the_leaking_stream():
+    agg = Aggregator(mem_growth_bytes_per_epoch=10_000.0)
+    for ep in range(1, 6):
+        agg.ingest(_epoch_record("flat", ep, [0.01] * 5,
+                                 peak=2 ** 30), stamp_time=False)
+        agg.ingest(_epoch_record("leaky", ep, [0.01] * 5,
+                                 peak=2 ** 30 + ep * 10 ** 6),
+                   stamp_time=False)
+    agg.emit_rollup()
+    growth = [a for a in agg.bridge.alerts
+              if a["reason"] == "mem_growth"]
+    assert growth and growth[0]["stream"] == "leaky/0"
+    assert growth[0]["slope_bytes_per_epoch"] > 10_000
+
+
+def test_operator_rules_fire_per_stream_and_fleet_wide():
+    agg = Aggregator(rules=("serve_queue_depth > 5",))
+    agg.ingest(_serve_record("r1", queue=2), stamp_time=False)
+    agg.ingest(_serve_record("r2", queue=4), stamp_time=False)
+    agg.emit_rollup()
+    fired = [a for a in agg.bridge.alerts
+             if a["reason"] == "gauge_predicate"]
+    # Fleet sum (6) breaches; neither replica (2, 4) does.
+    assert [a["scope"] for a in fired] == ["fleet"]
+    assert fired[0]["value"] == 6
+
+
+def test_bad_rule_fails_at_construction():
+    with pytest.raises(ValueError, match="bad gauge rule"):
+        Aggregator(rules=("what is this",))
+
+
+def test_identityless_records_fall_back_to_source_streams():
+    agg = Aggregator()
+    agg.ingest({"kind": "obs_step", "step": 1, "step_time_s": 0.01},
+               source="old-a.jsonl")
+    agg.ingest({"kind": "obs_step", "step": 1, "step_time_s": 0.02},
+               source="old-b.jsonl")
+    assert [s.key for s in agg.streams()] == ["old-a.jsonl",
+                                              "old-b.jsonl"]
+
+
+def test_drop_source_forgets_only_that_files_streams():
+    agg = Aggregator()
+    agg.ingest(_epoch_record("a", 1, [0.01] * 5), source="a.jsonl")
+    agg.ingest(_epoch_record("b", 1, [0.01] * 5), source="b.jsonl")
+    agg.drop_source("a.jsonl")
+    assert [s.key for s in agg.streams()] == ["b/0"]
+
+
+# ---------------------------------------------------------------------------
+# dashboard fleet mode
+# ---------------------------------------------------------------------------
+
+
+def _write_stream_files(tmp_path):
+    by_stream = _two_stream_records()
+    paths = []
+    for run, recs in by_stream.items():
+        path = tmp_path / f"{run}.jsonl"
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        paths.append(str(path))
+    return paths
+
+
+def test_dashboard_two_files_render_fleet_and_serve_panels(
+        tmp_path, capsys):
+    """Acceptance: --html renders the fleet + serve SLO panels from
+    two metrics.jsonl files without a live run."""
+    dash = _import_dashboard()
+    paths = _write_stream_files(tmp_path)
+    out = tmp_path / "fleet.html"
+    rc = dash.main(paths + ["--once", "--html", str(out)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "fleet dashboard" in text
+    assert "straggler" in text          # alert line in the frame
+    html = out.read_text()
+    assert "Serve SLO (fleet)" in html
+    assert "fleet TTFT p50" in html
+    assert "Fleet alerts" in html
+    assert "run-b/0" in html
+    assert "straggler factor" in html
+
+
+def test_dashboard_single_path_keeps_single_run_view(tmp_path, capsys):
+    dash = _import_dashboard()
+    paths = _write_stream_files(tmp_path)
+    rc = dash.main([paths[0], "--once"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "obs dashboard" in text      # not the fleet renderer
+    assert "fleet" not in text
+
+
+def test_dashboard_listen_fleet_routes_concurrent_posts(capsys):
+    """Two runs POSTing ndjson concurrently (the real
+    HttpLineTransport wire format) become two streams; GET returns
+    the fleet frame."""
+    dash = _import_dashboard()
+    from tpunet.obs.agg import Aggregator
+    from tpunet.obs.export.http import HttpLineTransport
+
+    agg = Aggregator(straggler_factor=2.0)
+    buf = dash.RecordBuffer()
+    server = dash.serve_http(0, buf, "test", agg=agg)
+    port = server.server_address[1]
+    try:
+        by_stream = _two_stream_records()
+        url = f"http://127.0.0.1:{port}/"
+        threads = [threading.Thread(
+            target=lambda recs=recs: HttpLineTransport(url, timeout=5)
+            .send_many(recs)) for recs in by_stream.values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        keys = [s.key for s in agg.streams()]
+        assert keys == ["run-a/0", "run-b/0",
+                        "serve-run-a/0", "serve-run-b/0"]
+        frame = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=5).read().decode()
+        assert "fleet dashboard" in frame
+        assert "run-b/0" in frame
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_straggler_latch_hands_off_to_a_new_offender():
+    """If replica B recovers while replica C degrades (the fleet
+    factor never dipping below threshold), C must still get its own
+    page — the latch is per offending stream."""
+    agg = Aggregator(straggler_factor=2.0)
+    agg.ingest(_epoch_record("a", 1, [0.01] * 20), stamp_time=False)
+    agg.ingest(_epoch_record("b", 1, [0.08] * 20), stamp_time=False)
+    agg.ingest(_epoch_record("c", 1, [0.012] * 20), stamp_time=False)
+    agg.emit_rollup()
+    # B recovers, C degrades — factor stays above threshold throughout.
+    agg.ingest(_epoch_record("b", 2, [0.011] * 20), stamp_time=False)
+    agg.ingest(_epoch_record("c", 2, [0.09] * 20), stamp_time=False)
+    agg.emit_rollup()
+    named = [(a["reason"], a["stream"]) for a in agg.bridge.alerts]
+    assert named == [("straggler", "b/0"), ("straggler", "c/0")]
+
+
+def test_mixed_unit_fleet_sums_each_unit():
+    agg = Aggregator()
+    agg.ingest(_epoch_record("lm", 1, [0.01] * 10, unit="tokens",
+                             thr=5000.0), stamp_time=False)
+    agg.ingest(_epoch_record("img", 1, [0.01] * 10, unit="examples",
+                             thr=300.0), stamp_time=False)
+    r = agg.rollup()
+    assert r["tokens_per_sec"] == pytest.approx(5000.0)
+    assert r["examples_per_sec"] == pytest.approx(300.0)
+    assert r["throughput_units"] == ["examples", "tokens"]
+    assert "throughput_unit" not in r
+
+
+def test_rule_with_malformed_number_gets_the_rule_diagnostic():
+    from tpunet.obs.health import GaugePredicate
+
+    for bad in ("mfu > 1e", "x + ../s", "y < +-3"):
+        with pytest.raises(ValueError, match="bad gauge rule"):
+            GaugePredicate.parse(bad)

@@ -243,3 +243,86 @@ def test_watchdog_default_run_stays_quiet(tmp_path):
         trainer.close()
     records = MetricsLogger.read_records(str(tmp_path / "metrics.jsonl"))
     assert not [r for r in records if r.get("kind") == "obs_alert"]
+
+
+# ---------------------------------------------------------------------------
+# GaugePredicate rules (--obs-rule)
+# ---------------------------------------------------------------------------
+
+
+def test_gauge_predicate_parse_forms():
+    from tpunet.obs.health import GaugePredicate
+
+    p = GaugePredicate.parse("serve_queue_depth > 10")
+    assert p.name == "serve_queue_depth" and p.above == 10.0
+    p = GaugePredicate.parse("mfu < 0.3")
+    assert p.below == 0.3
+    p = GaugePredicate.parse("bytes_in_use + 1e6/s")
+    assert p.grow_per_s == 1e6
+    for bad in ("", "mfu", "mfu >= 1", "mfu ! 3", "1 > mfu"):
+        with pytest.raises(ValueError, match="bad gauge rule"):
+            GaugePredicate.parse(bad)
+
+
+def test_gauge_predicate_threshold_fires_with_detail():
+    from tpunet.obs.health import GaugePredicate
+
+    p = GaugePredicate.parse("depth > 5")
+    assert p.evaluate({"depth": 5}, 0.0) is None
+    d = p.evaluate({"depth": 7}, 0.0)
+    assert d == {"rule": "depth > 5", "gauge": "depth", "value": 7,
+                 "threshold": 5.0}
+    # Missing / non-numeric / non-finite gauges never fire.
+    assert p.evaluate({}, 0.0) is None
+    assert p.evaluate({"depth": float("nan")}, 0.0) is None
+    assert p.evaluate({"depth": True}, 0.0) is None
+
+
+def test_gauge_predicate_growth_needs_a_trend():
+    from tpunet.obs.health import GaugePredicate
+
+    p = GaugePredicate.parse("mem + 10/s")
+    # Growing at 100/s: fires once MIN_POINTS samples exist.
+    assert p.evaluate({"mem": 0.0}, 0.0) is None
+    assert p.evaluate({"mem": 100.0}, 1.0) is None
+    d = p.evaluate({"mem": 200.0}, 2.0)
+    assert d is not None and d["slope_per_s"] == pytest.approx(100.0)
+    # A flat series does not fire.
+    q = GaugePredicate.parse("mem + 10/s")
+    for i in range(5):
+        assert q.evaluate({"mem": 42.0}, float(i)) is None
+
+
+def test_watchdog_check_gauges_emits_obs_alert_per_rule():
+    clock = [0.0]
+    wd, reg, sink = make_watchdog(
+        clock=lambda: clock[0], alert_cooldown_steps=50,
+        gauge_rules=("a > 1", "b > 1"))
+    reg.gauge("a").set(5.0)
+    reg.gauge("b").set(5.0)
+    wd.check_gauges(10, reg.snapshot())
+    alerts = sink.by_kind("obs_alert")
+    # Per-rule cooldown keys: both rules page in the same window.
+    assert len(alerts) == 2
+    assert {a["rule"] for a in alerts} == {"a > 1", "b > 1"}
+    assert all(a["reason"] == "gauge_predicate" for a in alerts)
+    assert all(a["severity"] == "warn" for a in alerts)
+    # Same rule inside the cooldown window is suppressed and counted.
+    wd.check_gauges(12, reg.snapshot())
+    assert len(sink.by_kind("obs_alert")) == 2
+    assert reg.counter("obs_alerts_suppressed").value == 2
+
+
+def test_obs_rule_cli_reaches_config():
+    from tpunet.config import config_from_args
+
+    cfg = config_from_args(["--obs-rule", "mfu < 0.3",
+                            "--obs-rule", "x + 1/s",
+                            "--run-id", "cli-run"])
+    assert cfg.obs.gauge_rules == ("mfu < 0.3", "x + 1/s")
+    assert cfg.obs.run_id == "cli-run"
+
+
+def test_bad_obs_rule_fails_at_watchdog_construction():
+    with pytest.raises(ValueError, match="bad gauge rule"):
+        make_watchdog(gauge_rules=("nope !",))

@@ -1,0 +1,208 @@
+"""Run-identity stamping end-to-end: run_id/process_index/host on
+JsonlSink records, ndjson HTTP exports, and StatsD name tags; run_id
+stability across a preemption restore; the serve frontend's replica
+default."""
+
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpunet.config import ObsConfig
+from tpunet.obs import JsonlSink, MemorySink, Observability
+from tpunet.obs.export import AsyncExporter, HttpLineTransport
+from tpunet.obs.export.statsd import record_to_lines
+from tpunet.obs.identity import ensure_run_id, run_identity
+from tpunet.obs.registry import Registry
+from tpunet.utils.logging import MetricsLogger
+
+IDENTITY_KEYS = ("run_id", "process_index", "host")
+
+
+def _drive(obs, sink):
+    obs.add_sink(sink)
+    obs.begin_epoch(1)
+    obs.observe_step(1, 0.01)
+    obs.end_epoch(epoch=1, step=1, units=10.0, train_seconds=0.1)
+
+
+def test_registry_emit_stamps_identity_and_record_wins():
+    reg = Registry()
+    sink = MemorySink()
+    reg.add_sink(sink)
+    reg.set_identity(run_id="r1", process_index=3, host="hostA")
+    reg.emit("obs_step", {"step": 7})
+    reg.emit("obs_step", {"step": 8, "host": "explicit"})
+    assert sink.records[0]["run_id"] == "r1"
+    assert sink.records[0]["process_index"] == 3
+    assert sink.records[0]["host"] == "hostA"
+    # An explicit record field outranks the stamp.
+    assert sink.records[1]["host"] == "explicit"
+
+
+def test_observability_records_carry_identity(tmp_path):
+    cfg = ObsConfig(step_records_every=1)
+    obs = Observability(cfg, checkpoint_dir=str(tmp_path))
+    sink = MemorySink()
+    _drive(obs, sink)
+    for kind in ("obs_step", "obs_epoch"):
+        rec = sink.by_kind(kind)[0]
+        for key in IDENTITY_KEYS:
+            assert key in rec, (kind, key)
+        assert rec["process_index"] == 0
+        assert rec["host"] == socket.gethostname()
+    # The id was persisted for restores.
+    assert (tmp_path / "run_id").read_text().strip() \
+        == sink.records[0]["run_id"]
+
+
+def test_jsonl_sink_records_carry_identity(tmp_path):
+    cfg = ObsConfig()
+    obs = Observability(cfg, checkpoint_dir=str(tmp_path))
+    logger = MetricsLogger(str(tmp_path))
+    _drive(obs, JsonlSink(logger))
+    records = MetricsLogger.read_records(
+        str(tmp_path / "metrics.jsonl"))
+    assert records
+    for rec in records:
+        for key in IDENTITY_KEYS:
+            assert key in rec
+
+
+def test_run_id_stable_across_preemption_restore(tmp_path):
+    d = str(tmp_path)
+    first = ensure_run_id(d, resume=False)
+    # The restore path (--resume) reuses the persisted id...
+    assert ensure_run_id(d, resume=True) == first
+    assert ensure_run_id(d, resume=True) == first
+    # ...and a FRESH run into the same directory gets a new one
+    # (mirrors MetricsLogger truncating metrics.jsonl).
+    assert ensure_run_id(d, resume=False) != first
+
+
+def test_observability_resume_continues_the_same_stream(tmp_path):
+    cfg = ObsConfig()
+    obs1 = Observability(cfg, checkpoint_dir=str(tmp_path))
+    rid = obs1.registry.identity()["run_id"]
+    obs2 = Observability(cfg, checkpoint_dir=str(tmp_path),
+                         resume=True)
+    assert obs2.registry.identity()["run_id"] == rid
+
+
+def test_explicit_run_id_wins_and_is_not_persisted_over(tmp_path):
+    cfg = ObsConfig(run_id="my-run")
+    obs = Observability(cfg, checkpoint_dir=str(tmp_path))
+    assert obs.registry.identity()["run_id"] == "my-run"
+
+
+def test_non_coordinator_identity_is_ephemeral(tmp_path):
+    ident = run_identity(directory=str(tmp_path), process_index=2,
+                         persist=False)
+    assert ident["process_index"] == 2
+    assert not (tmp_path / "run_id").exists()
+
+
+def test_http_ndjson_export_carries_identity():
+    """The full live path: registry emit -> AsyncExporter ->
+    HttpLineTransport ndjson POST -> receiver parses identity."""
+    received = []
+    done = threading.Event()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            for line in self.rfile.read(n).splitlines():
+                if line.strip():
+                    received.append(json.loads(line))
+            done.set()
+            self.send_response(204)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        reg = Registry()
+        reg.set_identity(run_id="wire-test", process_index=0,
+                         host="hostX")
+        exporter = AsyncExporter(
+            HttpLineTransport(f"http://127.0.0.1:{port}/", timeout=5),
+            name="http", registry=reg)
+        reg.add_sink(exporter)
+        reg.emit("obs_step", {"step": 1, "step_time_s": 0.01})
+        exporter.close()
+        assert done.wait(5)
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert received
+    assert received[0]["run_id"] == "wire-test"
+    assert received[0]["process_index"] == 0
+    assert received[0]["host"] == "hostX"
+
+
+def test_statsd_lines_carry_identity_as_name_tags():
+    record = {"kind": "obs_epoch", "run_id": "r9", "process_index": 1,
+              "host": "tpu-w-1", "step": 5, "mfu": 0.5}
+    lines = record_to_lines(record)
+    assert lines
+    for line in lines:
+        assert line.endswith(
+            "|g|#run_id:r9,process_index:1,host:tpu-w-1")
+    # Identity fields become tags, not gauges (process_index is
+    # numeric and would otherwise leak into the gauge namespace).
+    assert not any(".process_index:" in line.split("|")[0]
+                   for line in lines)
+    assert any(".step:5|g" in line for line in lines)
+
+
+def test_statsd_tag_values_are_sanitized():
+    lines = record_to_lines({"kind": "k", "run_id": "a|b#c,d",
+                             "x": 1})
+    assert lines == ["tpunet.k.x:1|g|#run_id:a_b_c_d"]
+
+
+def test_serve_frontend_defaults_replica_identity():
+    from tpunet.serve.frontend import ServeServer
+
+    class _Model:
+        vocab_size = 256
+
+    class _Engine:
+        def __init__(self):
+            self.registry = Registry()
+            self.model = _Model()
+
+    engine = _Engine()
+    server = ServeServer(engine, port=0)
+    try:
+        ident = engine.registry.identity()
+        assert ident["run_id"].startswith("serve-")
+        assert ident["host"] == socket.gethostname()
+    finally:
+        server.httpd.server_close()
+
+
+def test_serve_frontend_respects_existing_identity():
+    from tpunet.serve.frontend import ServeServer
+
+    class _Model:
+        vocab_size = 256
+
+    class _Engine:
+        def __init__(self):
+            self.registry = Registry()
+            self.model = _Model()
+
+    engine = _Engine()
+    engine.registry.set_identity(run_id="replica-7", process_index=0,
+                                 host="h")
+    server = ServeServer(engine, port=0)
+    try:
+        assert engine.registry.identity()["run_id"] == "replica-7"
+    finally:
+        server.httpd.server_close()

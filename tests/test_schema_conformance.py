@@ -1,0 +1,51 @@
+"""The docs/metrics_schema.md contract is machine-enforced: every
+record kind and field the obs / serve / agg layers can emit must be
+documented, so the schema can't silently drift again (the check
+drives the real emission paths — see scripts/check_metrics_schema.py).
+"""
+
+import os
+import sys
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _import_checker():
+    sys.path.insert(0, SCRIPTS)
+    try:
+        return __import__("check_metrics_schema")
+    finally:
+        sys.path.pop(0)
+
+
+def test_every_emitted_kind_and_field_is_documented(capsys):
+    checker = _import_checker()
+    rc = checker.main()
+    out = capsys.readouterr()
+    assert rc == 0, f"schema drift:\n{out.err}"
+    # The harness actually exercised every layer.
+    assert "obs_epoch" in out.out and "obs_serve" in out.out \
+        and "obs_fleet" in out.out and "obs_alert" in out.out
+
+
+def test_checker_catches_drift():
+    """The check is only worth its CI minutes if it actually fails on
+    an undocumented emission."""
+    checker = _import_checker()
+    kinds, fields, global_fields = checker.parse_schema()
+    bad = checker.undocumented(
+        [{"kind": "obs_epoch", "brand_new_field": 1},
+         {"kind": "obs_never_documented"}],
+        kinds, fields, global_fields)
+    assert ("obs_epoch", "brand_new_field") in bad
+    assert ("obs_never_documented", "<kind undocumented>") in bad
+
+
+def test_doc_parser_expands_brace_families():
+    checker = _import_checker()
+    kinds, fields, _ = checker.parse_schema()
+    # `ttft_{p50,p90,p99,mean}_s` in the obs_serve table must expand.
+    assert "ttft_p99_s" in fields["obs_serve"]
+    assert "token_latency_mean_s" in fields["obs_serve"]
+    assert "step_time_sample" in fields["obs_epoch"]
+    assert "straggler_factor" in fields["obs_fleet"]

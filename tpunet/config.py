@@ -313,6 +313,17 @@ class ObsConfig:
     # Fatal alerts raise RunUnhealthyError instead of just recording:
     # the --halt-on-unhealthy knob, for runs nobody is watching.
     halt_on_unhealthy: bool = False
+    # Run identity (docs/metrics_schema.md "Run identity"): every
+    # emitted record is stamped run_id/process_index/host so a fleet
+    # aggregator can route streams. Empty = generate (and persist
+    # under <checkpoint-dir>/run_id; --resume reuses it, so a
+    # preemption restore continues the same stream).
+    run_id: str = ""
+    # Operator GaugePredicate alert rules over exported gauges,
+    # evaluated each epoch against registry.snapshot(): "NAME > N",
+    # "NAME < N", or "NAME + N/s" (growth rate). Fired rules emit
+    # gauge_predicate obs_alerts (--obs-rule, repeatable).
+    gauge_rules: Tuple[str, ...] = ()
     export: ExportConfig = field(default_factory=ExportConfig)
 
 
@@ -356,6 +367,9 @@ class ServeConfig:
     # Graceful-drain budget on SIGTERM: stop admitting, finish
     # in-flight work for up to this long, then cancel survivors.
     drain_timeout_s: float = 30.0
+    # Replica identity on obs_serve records (fleet SLO rollups route
+    # by it). Empty = "serve-<host>-<pid>".
+    run_id: str = ""
 
 
 @dataclass(frozen=True)
@@ -610,6 +624,18 @@ def build_argparser() -> argparse.ArgumentParser:
                    metavar="SECONDS",
                    help="stale_heartbeat alert when no epoch "
                         "heartbeat lands for this long (0 = off)")
+    p.add_argument("--run-id", default=None,
+                   help="explicit run identity stamped on every obs "
+                        "record (default: generated and persisted "
+                        "under <checkpoint-dir>/run_id; --resume "
+                        "reuses it)")
+    p.add_argument("--obs-rule", action="append", default=None,
+                   metavar="RULE",
+                   help="GaugePredicate alert rule over any registry "
+                        "snapshot key, e.g. 'mfu < 0.3', "
+                        "'step_time_s_p99 > 2', "
+                        "'mem_peak_bytes_in_use + 1e6/s' (growth per "
+                        "second); repeatable, checked each epoch")
     p.add_argument("--log-every-steps", type=int, default=None,
                    help="emit a step/loss/lr line every N steps (0 = "
                         "per-epoch only, like the reference)")
@@ -656,6 +682,10 @@ def config_from_args(argv=None) -> TrainConfig:
         obs = dataclasses.replace(obs, export=export)
     if args.halt_on_unhealthy:
         obs = dataclasses.replace(obs, halt_on_unhealthy=True)
+    if args.run_id is not None:
+        obs = dataclasses.replace(obs, run_id=args.run_id)
+    if args.obs_rule:
+        obs = dataclasses.replace(obs, gauge_rules=tuple(args.obs_rule))
     for obs_field, arg in (("stall_factor", args.stall_factor),
                            ("stall_min_s", args.stall_min_s),
                            ("loss_spike_factor", args.loss_spike_factor),

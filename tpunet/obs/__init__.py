@@ -67,7 +67,8 @@ class Observability:
     """
 
     def __init__(self, cfg, *, profile_dir: str = "",
-                 checkpoint_dir: str = "", unit: str = "examples"):
+                 checkpoint_dir: str = "", unit: str = "examples",
+                 resume: bool = False):
         if cfg.step_records_every < 0:
             raise ValueError(f"obs.step_records_every must be >= 0, "
                              f"got {cfg.step_records_every}")
@@ -77,6 +78,20 @@ class Observability:
         self.registry = Registry()
         self._hist_max = getattr(cfg, "histogram_max_samples",
                                  Histogram.DEFAULT_MAX_SAMPLES)
+        if self.enabled:
+            # Identity stamp on every emitted record: the join keys
+            # (run_id / process_index / host) that make this run's
+            # stream mergeable by a fleet aggregator (tpunet/obs/agg/).
+            # run_id persists next to the checkpoints, so a preemption
+            # restore (resume=True) continues the SAME stream.
+            import jax
+
+            from tpunet.obs.identity import run_identity
+            pidx = jax.process_index()
+            self.registry.set_identity(**run_identity(
+                run_id=getattr(cfg, "run_id", ""),
+                directory=checkpoint_dir, resume=resume,
+                process_index=pidx, persist=(pidx == 0)))
         # Run-health watchdog: consumes the same host-side laps/losses
         # this facade already sees, emits obs_alert records through
         # the registry (so they reach metrics.jsonl and every live
@@ -213,6 +228,13 @@ class Observability:
             # record: a missing_processes alert then precedes the
             # epoch row it explains in metrics.jsonl.
             self.watchdog.observe_heartbeat(live, step=step)
+        # Bounded sample of the window's step-time distribution rides
+        # in the record: cross-stream percentile MERGES need sample
+        # points, not precomputed percentiles (a fleet p99 cannot be
+        # reconstructed from per-stream p99s) — see
+        # tpunet/obs/agg/merge.py for the error bound this carries.
+        sample = [round(v, 6) for v in
+                  reg.histogram("step_time_s").export_sample()]
         record = {
             "epoch": epoch,
             "step": step,
@@ -226,6 +248,7 @@ class Observability:
             "step_time_p90_s": steps.get("p90"),
             "step_time_p99_s": steps.get("p99"),
             **({"step_time_approx": 1} if steps.get("approx") else {}),
+            **({"step_time_sample": sample} if sample else {}),
             "input_stall_s": round(wait_total, 4),
             "stall_frac": round(wait_total / busy, 4) if busy > 0 else 0.0,
             "device_memory": mem,
@@ -234,6 +257,10 @@ class Observability:
         util = perf.mfu(throughput, self._flops_per_unit)
         if util is not None:
             record["mfu"] = round(util, 4)
+            # Mirror into a gauge so operator rules ("mfu < 0.3") and
+            # exporters can see it — record fields are not snapshot
+            # keys.
+            reg.gauge("mfu").set(util)
         ckpt_saves = reg.counter("ckpt_saves").value
         if ckpt_saves:
             record["ckpt_saves"] = int(ckpt_saves)
@@ -242,6 +269,11 @@ class Observability:
         if partial:
             record["partial"] = True
         reg.emit("obs_epoch", record)
+        if self.watchdog is not None and self.watchdog.gauge_predicates:
+            # Operator gauge rules (--obs-rule) see the same flat
+            # snapshot the exporters ship, evaluated once per epoch
+            # AFTER the record lands — alert-explains-record ordering.
+            self.watchdog.check_gauges(step, reg.snapshot())
         return record
 
     # -- lifecycle -------------------------------------------------------

@@ -32,18 +32,35 @@ def _num(val) -> str:
     return f"{val:.6f}".rstrip("0").rstrip(".")
 
 
+# Identity fields ride as name tags, not gauges: a fleet collector
+# needs to know WHICH run a gauge line belongs to, and statsd's only
+# record-shaped channel is the dogstatsd tag suffix.
+_TAG_FIELDS = ("run_id", "process_index", "host")
+
+
+def _tag_value(val) -> str:
+    """Tag values must not carry the protocol's delimiters."""
+    return str(val).replace("|", "_").replace("#", "_").replace(",", "_")
+
+
 def record_to_lines(record: dict, prefix: str = "tpunet") -> list:
     """Flatten a record's numeric scalar fields to statsd gauge lines;
     nested/str/bool fields are skipped (UDP metrics carry numbers, the
-    full record shape belongs to the jsonl/HTTP paths)."""
+    full record shape belongs to the jsonl/HTTP paths). The identity
+    stamp (run_id/process_index/host) becomes a dogstatsd-style tag
+    suffix ``|#run_id:...,process_index:...,host:...`` on every line
+    instead of a gauge, so multi-run collectors can split streams."""
     kind = record.get("kind", "record")
+    tags = ",".join(f"{k}:{_tag_value(record[k])}"
+                    for k in _TAG_FIELDS if record.get(k) is not None)
+    suffix = f"|#{tags}" if tags else ""
     lines = []
     for key, val in record.items():
-        if key == "kind" or isinstance(val, bool):
+        if key == "kind" or key in _TAG_FIELDS or isinstance(val, bool):
             continue
         if isinstance(val, int) or (isinstance(val, float)
                                     and math.isfinite(val)):
-            lines.append(f"{prefix}.{kind}.{key}:{_num(val)}|g")
+            lines.append(f"{prefix}.{kind}.{key}:{_num(val)}|g{suffix}")
     return lines
 
 

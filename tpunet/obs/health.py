@@ -27,10 +27,136 @@ post-mortem shows *why* the run stopped.
 from __future__ import annotations
 
 import math
+import re
 import threading
 import time
 from collections import deque
 from typing import Optional
+
+
+class GaugePredicate:
+    """Alert rule over any exported gauge / snapshot key.
+
+    The watchdog's built-in predicates cover the failure shapes we
+    could name in advance; these cover the ones the operator names at
+    launch time (``--obs-rule``), and the fleet aggregator evaluates
+    the same rules per-stream and fleet-wide. Three rule forms, one
+    spec grammar::
+
+        serve_queue_depth > 10        # fire while above a threshold
+        mfu < 0.3                     # fire while below
+        bytes_in_use + 1e6 / s        # fire when the least-squares
+                                      # growth rate exceeds 1e6 per
+                                      # second (leak shape)
+
+    Threshold rules are stateless; growth rules keep a bounded
+    ``(t, value)`` series per predicate instance, so evaluate one
+    instance per stream (the aggregator does). ``evaluate`` returns a
+    detail dict when the rule fires, else None — alert routing
+    (cooldown, halt, emission) belongs to the caller.
+    """
+
+    # NAME > VALUE | NAME < VALUE | NAME + VALUE / s
+    _SPEC = re.compile(
+        r"^\s*([A-Za-z_][A-Za-z0-9_.]*)\s*"
+        r"(?:([<>])\s*([-+0-9.eE]+)"
+        r"|\+\s*([-+0-9.eE]+)\s*/\s*s)\s*$")
+
+    WINDOW = 32          # growth-rule series bound
+    MIN_POINTS = 3       # growth needs a trend, not two samples
+
+    def __init__(self, name: str, *, above: Optional[float] = None,
+                 below: Optional[float] = None,
+                 grow_per_s: Optional[float] = None,
+                 fatal: bool = False, spec: str = ""):
+        if sum(x is not None for x in (above, below, grow_per_s)) != 1:
+            raise ValueError(
+                "exactly one of above/below/grow_per_s is required")
+        self.name = name
+        self.above = above
+        self.below = below
+        self.grow_per_s = grow_per_s
+        self.fatal = fatal
+        self.spec = spec or self._render_spec()
+        self._series: deque = deque(maxlen=self.WINDOW)
+
+    def _render_spec(self) -> str:
+        if self.above is not None:
+            return f"{self.name} > {self.above:g}"
+        if self.below is not None:
+            return f"{self.name} < {self.below:g}"
+        return f"{self.name} + {self.grow_per_s:g}/s"
+
+    @classmethod
+    def parse(cls, spec: str, *, fatal: bool = False) -> "GaugePredicate":
+        def bad():
+            return ValueError(
+                f"bad gauge rule {spec!r} (expected 'NAME > N', "
+                f"'NAME < N', or 'NAME + N/s')")
+
+        m = cls._SPEC.match(spec)
+        if not m:
+            raise bad()
+        name, cmp_op, threshold, rate = m.groups()
+        try:
+            # The numeric charset is permissive ("1e", "+-3" match);
+            # float() is the real validator — fold its failure into
+            # the one diagnostic every malformed rule gets.
+            value = float(rate if rate is not None else threshold)
+        except ValueError:
+            raise bad() from None
+        if rate is not None:
+            return cls(name, grow_per_s=value, fatal=fatal,
+                       spec=spec.strip())
+        if cmp_op == ">":
+            return cls(name, above=value, fatal=fatal,
+                       spec=spec.strip())
+        return cls(name, below=value, fatal=fatal, spec=spec.strip())
+
+    def evaluate(self, snapshot: dict, now: float) -> Optional[dict]:
+        """One snapshot against the rule. Growth rules also fold the
+        sample into their series (so call once per snapshot)."""
+        val = snapshot.get(self.name)
+        if val is None or isinstance(val, bool) \
+                or not isinstance(val, (int, float)) \
+                or not math.isfinite(val):
+            return None
+        if self.above is not None:
+            if val > self.above:
+                return {"rule": self.spec, "gauge": self.name,
+                        "value": val, "threshold": self.above}
+            return None
+        if self.below is not None:
+            if val < self.below:
+                return {"rule": self.spec, "gauge": self.name,
+                        "value": val, "threshold": self.below}
+            return None
+        self._series.append((float(now), float(val)))
+        if len(self._series) < self.MIN_POINTS:
+            return None
+        slope = _slope(self._series)
+        if slope is not None and slope > self.grow_per_s:
+            return {"rule": self.spec, "gauge": self.name,
+                    "value": val,
+                    "slope_per_s": round(slope, 6),
+                    "threshold": self.grow_per_s}
+        return None
+
+
+def _slope(series) -> Optional[float]:
+    """Least-squares slope of (t, value) pairs; None on a degenerate
+    time axis."""
+    n = len(series)
+    t0 = series[0][0]
+    ts = [t - t0 for t, _ in series]
+    vs = [v for _, v in series]
+    t_mean = sum(ts) / n
+    v_mean = sum(vs) / n
+    denom = sum((t - t_mean) ** 2 for t in ts)
+    if denom <= 0:
+        return None
+    return sum((t - t_mean) * (v - v_mean)
+               for t, v in zip(ts, vs)) / denom
 
 
 class RunUnhealthyError(RuntimeError):
@@ -71,6 +197,11 @@ class Watchdog:
         self._monitor: Optional[threading.Thread] = None
         self._stop_monitor = threading.Event()
         self.alerts: list = []
+        # Operator-defined GaugePredicate rules (--obs-rule), checked
+        # against registry.snapshot() at epoch boundaries.
+        self.gauge_predicates: list = []
+        for spec in getattr(cfg, "gauge_rules", ()) or ():
+            self.gauge_predicates.append(GaugePredicate.parse(spec))
 
     # -- observations ----------------------------------------------------
 
@@ -140,6 +271,23 @@ class Watchdog:
             self._alert("stale_heartbeat", step, fatal=False, detail={
                 "age_s": round(age, 2), "timeout_s": timeout})
 
+    def check_gauges(self, step: int, snapshot: dict) -> None:
+        """Evaluate every configured ``GaugePredicate`` against a
+        registry snapshot (the epoch-boundary hook — the same flat
+        gauge view the exporters ship). Fired rules emit a
+        ``gauge_predicate`` obs_alert through the normal path
+        (cooldown, halt, record-first ordering all apply); the rule
+        spec rides in the detail so the page says which rule."""
+        now = self._clock()
+        for pred in self.gauge_predicates:
+            detail = pred.evaluate(snapshot, now)
+            if detail is not None:
+                # Cooldown per rule, not per reason: two different
+                # rules firing in the same window are two pages.
+                self._alert("gauge_predicate", step,
+                            fatal=pred.fatal, detail=detail,
+                            cooldown_key=f"gauge_predicate:{pred.spec}")
+
     # -- wedge monitor ---------------------------------------------------
 
     def start_monitor(self) -> None:
@@ -184,8 +332,9 @@ class Watchdog:
     # -- alert emission --------------------------------------------------
 
     def _alert(self, reason: str, step: int, *, fatal: bool,
-               detail: dict) -> None:
-        last = self._last_alert_step.get(reason)
+               detail: dict, cooldown_key: str = "") -> None:
+        key = cooldown_key or reason
+        last = self._last_alert_step.get(key)
         cooldown = self.cfg.alert_cooldown_steps
         if (last is not None and cooldown > 0 and step - last < cooldown):
             # Uniform suppression, fatal included: on the raising path
@@ -196,7 +345,7 @@ class Watchdog:
             # prevent (guard.request is idempotent, one call suffices).
             self.registry.counter("obs_alerts_suppressed").inc()
             return
-        self._last_alert_step[reason] = step
+        self._last_alert_step[key] = step
         self.registry.counter("obs_alerts").inc()
         record = {"reason": reason, "step": step,
                   "severity": "fatal" if fatal else "warn"}

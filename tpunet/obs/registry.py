@@ -49,15 +49,22 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins sample (e.g. device bytes in use)."""
+    """Last-write-wins sample (e.g. device bytes in use). The single
+    float store in ``set`` is atomic under the GIL today; the lock
+    exists to pin the instrument-mutation discipline (Counter and
+    Histogram hold one) so a future compound setter — min/max
+    tracking, delta-from-previous — cannot silently reintroduce the
+    serve-path race between HTTP handler threads and the engine."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value: Optional[float] = None
+        self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
-        self.value = float(value)
+        with self._lock:
+            self.value = float(value)
 
 
 class Histogram:
@@ -72,11 +79,24 @@ class Histogram:
     uniform sample of the window and percentiles become approximate —
     ``count`` and ``total`` stay exact either way. The default bound
     holds a long epoch of float laps in ~0.5 MB.
+
+    ``observe`` (and every reader) holds a lock: the serving path
+    observes ``serve_*`` latency histograms from HTTP handler threads
+    concurrently with the engine thread, and the unlocked
+    count/total/reservoir updates lose observations under that race —
+    same discipline as ``Counter.inc``, one uncontended acquire on the
+    trainer's single-threaded hot path.
     """
 
-    __slots__ = ("values", "max_samples", "_count", "_total", "_rng")
+    __slots__ = ("values", "max_samples", "_count", "_total", "_rng",
+                 "_lock")
 
     DEFAULT_MAX_SAMPLES = 65536
+    # Bound on the per-record exported sample (``export_sample``):
+    # large enough that rank-space quantile error stays small (see
+    # docs/metrics_schema.md), small enough that an obs_epoch record
+    # stays a few KB.
+    EXPORT_SAMPLE_MAX = 256
 
     def __init__(self, max_samples: int = DEFAULT_MAX_SAMPLES):
         if max_samples < 1:
@@ -86,20 +106,23 @@ class Histogram:
         self._count = 0
         self._total = 0.0
         self._rng = random.Random(0x0B5)
+        self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
         value = float(value)
-        self._count += 1
-        self._total += value
-        if len(self.values) < self.max_samples:
-            self.values.append(value)
-            return
-        # Reservoir (Algorithm R): keep each of the n seen so far with
-        # probability max_samples/n — percentiles degrade to a uniform
-        # sample of the window instead of the list growing unboundedly.
-        j = self._rng.randrange(self._count)
-        if j < self.max_samples:
-            self.values[j] = value
+        with self._lock:
+            self._count += 1
+            self._total += value
+            if len(self.values) < self.max_samples:
+                self.values.append(value)
+                return
+            # Reservoir (Algorithm R): keep each of the n seen so far
+            # with probability max_samples/n — percentiles degrade to a
+            # uniform sample of the window instead of the list growing
+            # unboundedly.
+            j = self._rng.randrange(self._count)
+            if j < self.max_samples:
+                self.values[j] = value
 
     def __len__(self) -> int:
         return self._count
@@ -119,9 +142,11 @@ class Histogram:
     def percentile(self, q: float) -> Optional[float]:
         """Linear-interpolated q-th percentile (q in [0, 100]); None on
         an empty window."""
-        if not self.values:
+        with self._lock:
+            xs = sorted(self.values)
+        if not xs:
             return None
-        return self._interp(sorted(self.values), q)
+        return self._interp(xs, q)
 
     def summary(self) -> Dict[str, float]:
         """{count, mean, p50, p90, p99} of the current window (empty
@@ -129,24 +154,42 @@ class Histogram:
         percentiles. ``count``/``mean`` are exact even when the window
         saturated the reservoir (percentiles are then approximate, and
         the summary says so with ``approx: 1``)."""
-        if not self.values:
+        with self._lock:
+            xs = sorted(self.values)
+            count, total = self._count, self._total
+        if not xs:
             return {}
-        xs = sorted(self.values)
         out = {
-            "count": self._count,
-            "mean": self._total / self._count,
+            "count": count,
+            "mean": total / count,
             "p50": self._interp(xs, 50),
             "p90": self._interp(xs, 90),
             "p99": self._interp(xs, 99),
         }
-        if self.saturated:
+        if count > self.max_samples:
             out["approx"] = 1
         return out
 
+    def export_sample(self, max_n: int = EXPORT_SAMPLE_MAX) -> List[float]:
+        """The window's bounded sample, sorted, for cross-stream
+        percentile merging (tpunet/obs/agg/merge.py). Up to ``max_n``
+        points the stored sample is returned whole; beyond that it is
+        compressed to ``max_n`` rank-strided points — the values at
+        ranks (i + 0.5)/max_n — which preserves any quantile of the
+        stored sample to within 1/(2*max_n) in rank. Combined with the
+        reservoir's own DKW bound once saturated, a merged quantile's
+        total rank error is documented in docs/metrics_schema.md."""
+        with self._lock:
+            xs = sorted(self.values)
+        if len(xs) <= max_n:
+            return xs
+        return [xs[int((i + 0.5) * len(xs) / max_n)] for i in range(max_n)]
+
     def reset(self) -> None:
-        self.values = []
-        self._count = 0
-        self._total = 0.0
+        with self._lock:
+            self.values = []
+            self._count = 0
+            self._total = 0.0
 
 
 class MemorySink:
@@ -192,6 +235,19 @@ class Registry:
         self._histograms: Dict[str, Histogram] = {}
         self._sinks: list = []
         self._lock = threading.Lock()
+        self._identity: Dict[str, object] = {}
+
+    def set_identity(self, **fields) -> None:
+        """Stamp every subsequently emitted record with these fields
+        (``run_id`` / ``process_index`` / ``host`` — the join keys the
+        fleet aggregator routes streams by; docs/metrics_schema.md
+        "Run identity"). None values are dropped; an explicit record
+        field of the same name wins over the stamp."""
+        self._identity = {k: v for k, v in fields.items()
+                          if v is not None}
+
+    def identity(self) -> Dict[str, object]:
+        return dict(self._identity)
 
     def _claim(self, name: str, family: Dict) -> None:
         """One name, one instrument family: a counter and a gauge
@@ -231,8 +287,10 @@ class Registry:
         self._sinks.append(sink)
 
     def emit(self, kind: str, record: dict) -> None:
-        """Tag and fan a finished record out to every sink."""
+        """Tag, identity-stamp, and fan a finished record out to every
+        sink."""
         rec = {"kind": kind}
+        rec.update(self._identity)
         rec.update(record)
         for sink in self._sinks:
             sink.write(rec)

@@ -62,6 +62,10 @@ def build_argparser():
                    help="stream obs_serve records as statsd/UDP gauges")
     p.add_argument("--obs-http", default="", metavar="URL",
                    help="POST obs_serve records as line-JSON")
+    p.add_argument("--run-id", default=d.run_id,
+                   help="replica identity stamped on obs_serve records "
+                        "(fleet rollups route by it; default "
+                        "serve-<host>-<pid>)")
     # LM architecture (must match the trained checkpoint) — mirrors
     # tpunet.infer.generate's flags.
     p.add_argument("--model", choices=("lm", "lm_pp"), default="lm")
@@ -112,7 +116,8 @@ def build_server(args):
         classify_batch_max=args.classify_batch_max,
         classify_window_ms=args.classify_window_ms,
         emit_every_s=args.emit_every_s,
-        drain_timeout_s=args.drain_timeout_s)
+        drain_timeout_s=args.drain_timeout_s,
+        run_id=args.run_id)
     model_cfg = ModelConfig(
         name=args.model, vit_hidden=args.vit_hidden,
         vit_depth=args.vit_depth, vit_heads=args.vit_heads,
@@ -161,7 +166,7 @@ def build_server(args):
     return ServeServer(engine, classify_batcher=batcher,
                        host=cfg.host, port=cfg.port,
                        metrics_logger=metrics_logger,
-                       exporters=exporters)
+                       exporters=exporters, run_id=cfg.run_id)
 
 
 def main(argv=None) -> int:

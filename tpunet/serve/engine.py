@@ -69,6 +69,55 @@ def sample_token(logits: np.ndarray, req: GenerateRequest) -> int:
     return int(req.rng().choice(v, p=p))
 
 
+def build_serve_record(reg, *, queue_depth: int, active_slots: int,
+                       slots: int, uptime_s: float, window_s: float,
+                       final: bool = False) -> dict:
+    """The ``obs_serve`` record body (docs/metrics_schema.md):
+    cumulative counters + window histogram summaries. Module-level so
+    the schema-conformance check can exercise the exact record shape
+    without standing up an engine; the TTFT/e2e histograms also export
+    their bounded window sample — the fleet aggregator merges replica
+    SLO percentiles from sample points, not from per-replica p99s."""
+    record = {
+        "uptime_s": round(uptime_s, 3),
+        "window_s": round(window_s, 3),
+        "queue_depth": queue_depth,
+        "active_slots": active_slots,
+        "slots": slots,
+        "requests_total": int(
+            reg.counter("serve_requests_total").value),
+        "requests_completed": int(
+            reg.counter("serve_requests_completed").value),
+        "requests_rejected": int(
+            reg.counter("serve_requests_rejected").value),
+        "tokens_total": int(reg.counter("serve_tokens_total").value),
+        "decode_steps_total": int(
+            reg.counter("serve_decode_steps_total").value),
+        "prefills_total": int(
+            reg.counter("serve_prefills_total").value),
+    }
+    for name, key in (("serve_ttft_s", "ttft"),
+                      ("serve_token_s", "token_latency"),
+                      ("serve_e2e_s", "e2e"),
+                      ("serve_prefill_s", "prefill")):
+        hist = reg.histogram(name)
+        summ = hist.summary()
+        for stat in ("p50", "p90", "p99", "mean", "count"):
+            if stat in summ:
+                record[f"{key}_{stat}_s" if stat != "count"
+                       else f"{key}_count"] = (
+                    round(summ[stat], 6) if stat != "count"
+                    else int(summ[stat]))
+        if key in ("ttft", "e2e") and summ:
+            record[f"{key}_sample"] = [
+                round(v, 6) for v in hist.export_sample()]
+            if summ.get("approx"):
+                record[f"{key}_approx"] = 1
+    if final:
+        record["final"] = True
+    return record
+
+
 class _Slot:
     """Host-side bookkeeping for one KV-cache row."""
 
@@ -489,36 +538,9 @@ class Engine:
         now = time.perf_counter()
         window = now - self._last_emit
         self._last_emit = now
-        record = {
-            "uptime_s": round(now - self._started, 3),
-            "window_s": round(window, 3),
-            "queue_depth": self.queue.depth(),
-            "active_slots": self.active_slots(),
-            "slots": self.slots,
-            "requests_total": int(
-                reg.counter("serve_requests_total").value),
-            "requests_completed": int(
-                reg.counter("serve_requests_completed").value),
-            "requests_rejected": int(
-                reg.counter("serve_requests_rejected").value),
-            "tokens_total": int(reg.counter("serve_tokens_total").value),
-            "decode_steps_total": int(
-                reg.counter("serve_decode_steps_total").value),
-            "prefills_total": int(
-                reg.counter("serve_prefills_total").value),
-        }
-        for name, key in (("serve_ttft_s", "ttft"),
-                          ("serve_token_s", "token_latency"),
-                          ("serve_e2e_s", "e2e"),
-                          ("serve_prefill_s", "prefill")):
-            summ = reg.histogram(name).summary()
-            for stat in ("p50", "p90", "p99", "mean", "count"):
-                if stat in summ:
-                    record[f"{key}_{stat}_s" if stat != "count"
-                           else f"{key}_count"] = (
-                        round(summ[stat], 6) if stat != "count"
-                        else int(summ[stat]))
-        if final:
-            record["final"] = True
+        record = build_serve_record(
+            reg, queue_depth=self.queue.depth(),
+            active_slots=self.active_slots(), slots=self.slots,
+            uptime_s=now - self._started, window_s=window, final=final)
         reg.emit("obs_serve", record)
         reg.reset_window()

@@ -54,10 +54,22 @@ class ServeServer:
 
     def __init__(self, engine: Engine, *, classify_batcher=None,
                  host: str = "127.0.0.1", port: int = 8000,
-                 metrics_logger=None, exporters=()):
+                 metrics_logger=None, exporters=(), run_id: str = ""):
         self.engine = engine
         self.classify = classify_batcher
         self.registry = engine.registry
+        if not self.registry.identity():
+            # Replica identity on every obs_serve record: the fleet
+            # aggregator routes replica streams by it (one replica =
+            # one run_id). serve has no checkpoint-persisted id, so
+            # the default is host+pid — stable for the server's life,
+            # unique across replicas on one host.
+            import os
+            import socket
+            self.registry.set_identity(
+                run_id=run_id or f"serve-{socket.gethostname()}"
+                                 f"-{os.getpid()}",
+                process_index=0, host=socket.gethostname())
         self.vocab_size = int(engine.model.vocab_size)
         self._metrics_logger = metrics_logger
         self._exporters = list(exporters)

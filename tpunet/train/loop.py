@@ -208,7 +208,10 @@ class Trainer:
         self.obs = Observability(
             cfg.obs, profile_dir=cfg.profile_dir,
             checkpoint_dir=cfg.checkpoint.directory,
-            unit="tokens" if self.is_lm else "examples")
+            unit="tokens" if self.is_lm else "examples",
+            # resume keeps the persisted run_id, so the restored
+            # stream continues the same fleet identity.
+            resume=cfg.checkpoint.resume)
         from tpunet.models import num_params
         self.obs.set_flops_per_unit(train_flops_per_unit(
             cfg.model, cfg.data, n_params=num_params(state.params)))
@@ -479,6 +482,10 @@ class Trainer:
         # metrics.jsonl; MetricsLogger already restricts writes to the
         # coordinator.
         self.obs.add_sink(JsonlSink(metrics_log))
+        # The PLAIN epoch records below bypass Registry.emit, so stamp
+        # them here: without identity the fleet aggregator would file
+        # them under a junk per-file stream instead of this run's.
+        identity = self.obs.registry.identity()
         total = Timer()
         self.guard.install()
         try:
@@ -524,6 +531,7 @@ class Trainer:
                     # so resumed metrics.jsonl readers can tell this row
                     # apart from a completed epoch (VERDICT r1 item 10).
                     metrics_log.log({
+                        **identity,
                         "epoch": epoch, "partial": True,
                         "step": self.global_step,
                         "seconds": timer.elapsed(),
@@ -552,6 +560,7 @@ class Trainer:
                                 train_m["loss"], train_m["accuracy"],
                                 test_m["loss"], test_m["accuracy"]))
                 record = {
+                    **identity,
                     "epoch": epoch, "seconds": secs,
                     "step": self.global_step,
                     # throughput over the epoch (eval pass included),
