@@ -181,16 +181,28 @@ def _measure(per_chip_batch: int, timed: int = 24, image_size: int = 224):
     # XLA's own FLOP count for one execution of the whole step program
     # (augment + fwd + bwd + Adam) feeds the MFU estimate; the roofline
     # bytes come from the materialized-tensor jaxpr walk (method note
-    # at _HBM_BW), with the raw cost-analysis count kept for reference.
+    # at _HBM_BW), with the raw cost-analysis count kept for reference
+    # and DECOMPOSED by op category from the optimized module text
+    # (tpunet/obs/hlo_bytes.py) so a bytes regression names the
+    # category that moved.
     flops = xla_bytes = traffic = 0.0
+    bytes_breakdown = None
     try:
         gx, gy = batches[0]
-        ca = step.lower(state, gx, gy, step_key(0, 0)).compile() \
-                 .cost_analysis()
+        compiled = step.lower(state, gx, gy, step_key(0, 0)).compile()
+        ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         flops = float(ca.get("flops", 0.0))
         xla_bytes = float(ca.get("bytes accessed", 0.0))
+        try:
+            from tpunet.obs import hlo_bytes
+            # compiled.as_text() is the per-device SPMD module, like
+            # cost_analysis — scale by the per-chip image count.
+            bytes_breakdown = hlo_bytes.per_image_breakdown(
+                compiled.as_text(), batch // n_chips)
+        except Exception as e:
+            _note(f"byte attribution unavailable: {e}")
     except Exception as e:  # cost analysis is best-effort per backend
         _note(f"cost_analysis unavailable: {e}")
     try:
@@ -212,7 +224,7 @@ def _measure(per_chip_batch: int, timed: int = 24, image_size: int = 224):
 
     trainer.close()
     return (timed * batch / best_dt / n_chips, flops, best_dt / timed,
-            traffic, xla_bytes, batch // n_chips)
+            traffic, xla_bytes, batch // n_chips, bytes_breakdown)
 
 
 def main() -> None:
@@ -220,22 +232,24 @@ def main() -> None:
     if "--smoke" in sys.argv[1:]:
         # Harness sanity check on small shapes (CPU-friendly); numbers
         # are meaningless, the JSON plumbing is what's exercised.
-        peak_ips, flops, dt_step, traffic, xla_bytes, pcb = _measure(
-            8, timed=3, image_size=32)
-        ref_ips, _, _, _, _, _ = _measure(4, timed=3, image_size=32)
+        (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
+         breakdown) = _measure(8, timed=3, image_size=32)
+        ref_ips = _measure(4, timed=3, image_size=32)[0]
     elif "--peak-only" in sys.argv[1:]:
         # Flag/variant sweeps: just the peak-shape number (the batch-128
         # companion costs a second warmup and doesn't move with flags).
         # The batch128_* fields become null — aliasing them to the
         # batch-512 figure would fabricate a measurement under a name
         # that promises the reference shape.
-        peak_ips, flops, dt_step, traffic, xla_bytes, pcb = _measure(512)
+        (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
+         breakdown) = _measure(512)
         ref_ips = None
     else:
         # Peak-throughput shape (per-chip batch sweep optimum) and the
         # reference's exact shape (cifar10_128batch.py:59: batch 128).
-        peak_ips, flops, dt_step, traffic, xla_bytes, pcb = _measure(512)
-        ref_ips, _, _, _, _, _ = _measure(128)
+        (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
+         breakdown) = _measure(512)
+        ref_ips = _measure(128)[0]
 
     peak = _peak_flops_per_chip()
     bw = _chip_spec(_HBM_BW)
@@ -259,7 +273,7 @@ def main() -> None:
         pct = round(peak_ips / roofline, 4)
         bound = ("hbm" if traffic / bw > flops / peak else "compute")
 
-    print(json.dumps({
+    record = {
         "metric": "train_images_per_sec_per_chip",
         "value": round(peak_ips, 2),
         "unit": "img/s/chip",
@@ -279,8 +293,26 @@ def main() -> None:
                                      if traffic else None),
         "xla_bytes_accessed_per_image": (round(xla_bytes / pcb)
                                          if xla_bytes else None),
+        # Per-HLO-op-category decomposition of the cost-analysis bytes
+        # (tpunet/obs/hlo_bytes.py; 'total' is the parsed sum, which
+        # tracks xla_bytes_accessed_per_image to <1%).
+        "bytes_per_image_breakdown": breakdown,
         "device_kind": jax.devices()[0].device_kind,
-    }))
+    }
+    print(json.dumps(record))
+
+    if "--enforce-budget" in sys.argv[1:]:
+        # Regression gate against the checked-in budget
+        # (docs/bytes_budget.json): nonzero exit when bytes/image
+        # regresses past the budget's tolerance on this device kind.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from check_bytes_budget import check_record, load_budget
+        ok, msgs = check_record(record, load_budget())
+        for m in msgs:
+            _note(m)
+        if not ok:
+            sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""HBM bytes-per-image regression gate for the training step.
+
+Compares a bench.py JSON record against the checked-in budget
+(docs/bytes_budget.json) and exits nonzero when
+``xla_bytes_accessed_per_image`` (or any budgeted breakdown category)
+regresses more than the budget's tolerance on this device kind.
+
+Usage:
+    python bench.py | python scripts/check_bytes_budget.py -
+    python scripts/check_bytes_budget.py BENCH_r05.json
+    python bench.py --enforce-budget          # same gate, in-process
+
+Budget file semantics (docs/bytes_budget.json):
+
+- ``budgets`` maps a device-kind substring (matched case-insensitively
+  against the record's ``device_kind``) to its accepted measurement:
+  ``xla_bytes_accessed_per_image`` (bytes) and optionally
+  ``breakdown`` ({category: bytes} from ``bytes_per_image_breakdown``).
+- The gate FAILS when measured > budget * (1 + tolerance_pct/100).
+  The budget is the last ACCEPTED measurement, not an aspiration: a
+  PR that improves bytes/image should ratchet the budget down to the
+  new measurement in the same change.
+- A device kind with no budget entry passes with a note (the CPU
+  backend's fusion behavior is not byte-comparable to TPU's, so no
+  CPU budget is checked in).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BUDGET = os.path.join(REPO, "docs", "bytes_budget.json")
+
+
+def load_budget(path: str = DEFAULT_BUDGET) -> Dict:
+    with open(path) as fp:
+        return json.load(fp)
+
+
+def _find_budget(budgets: Dict, device_kind: str):
+    kind = (device_kind or "").lower()
+    for key, val in budgets.items():
+        if key.lower() in kind:
+            return key, val
+    return None, None
+
+
+def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
+    """-> (ok, messages). ok is False only on a real regression; a
+    missing budget entry or missing measurement passes with a note
+    (a broken measurement already shows as null in the bench JSON —
+    the gate's job is catching byte REGRESSIONS, not re-checking the
+    bench's plumbing)."""
+    tol = float(budget.get("tolerance_pct", 5.0)) / 100.0
+    key, entry = _find_budget(budget.get("budgets", {}),
+                              record.get("device_kind", ""))
+    if entry is None:
+        return True, [f"no bytes budget for device kind "
+                      f"{record.get('device_kind')!r}; nothing to enforce"]
+    msgs, ok = [], True
+
+    def gate(name: str, measured, budgeted) -> None:
+        nonlocal ok
+        if budgeted is None:
+            return
+        if measured is None:
+            msgs.append(f"{name}: no measurement in record (budget "
+                        f"{budgeted:.0f}); skipping")
+            return
+        limit = budgeted * (1.0 + tol)
+        verdict = "OK" if measured <= limit else "REGRESSION"
+        msgs.append(
+            f"{name}: measured {measured / 1e6:.1f} MB vs budget "
+            f"{budgeted / 1e6:.1f} MB (+{100 * tol:.0f}% tolerance -> "
+            f"limit {limit / 1e6:.1f} MB) [{verdict}]")
+        if measured > limit:
+            ok = False
+
+    gate(f"{key}: xla_bytes_accessed_per_image",
+         record.get("xla_bytes_accessed_per_image"),
+         entry.get("xla_bytes_accessed_per_image"))
+    bd = record.get("bytes_per_image_breakdown") or {}
+    for cat, budgeted in (entry.get("breakdown") or {}).items():
+        gate(f"{key}: breakdown[{cat}]", bd.get(cat), budgeted)
+    return ok, msgs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    path = argv[0]
+    budget_path = DEFAULT_BUDGET
+    if "--budget" in argv:
+        budget_path = argv[argv.index("--budget") + 1]
+    raw = sys.stdin.read() if path == "-" else open(path).read()
+    # Accept a plain JSON file (pretty-printed artifacts like
+    # BENCH_r05.json included) OR a piped bench stdout stream, whose
+    # '#' notes precede the one-line record.
+    try:
+        record = json.loads(raw)
+    except json.JSONDecodeError:
+        lines = [ln for ln in raw.splitlines()
+                 if ln.strip().startswith("{")]
+        record = json.loads(lines[-1])
+    # Driver-style bench artifacts wrap the record ({"parsed": {...}}).
+    if "parsed" in record and isinstance(record["parsed"], dict):
+        record = record["parsed"]
+    ok, msgs = check_record(record, load_budget(budget_path))
+    for m in msgs:
+        print(m)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,6 +5,7 @@ Usage:
     python scripts/obs_report.py checkpoints/metrics.jsonl
     python scripts/obs_report.py checkpoints/          # finds metrics.jsonl
     python scripts/obs_report.py checkpoints/ --json   # machine-readable
+    python scripts/obs_report.py checkpoints/ --trace checkpoints/profile
 
 Prints the per-epoch training table, the step-time percentile /
 input-stall summary from the ``obs_epoch`` records, the per-window
@@ -14,6 +15,13 @@ one JSON object (the ``tpunet.obs.summary.summarize`` schema — the
 exact structure the live dashboard renders, so the two views cannot
 drift). Tolerates a truncated trailing line (a crashed or preempted
 run's artifact) via ``MetricsLogger.read_records``.
+
+``--trace DIR`` additionally attributes MEASURED device time to
+training phases (fwd / bwd / optimizer / ema / eval) from the
+windowed profiler's xplane under DIR (``--profile-dir``, or
+``<checkpoint-dir>/profile``) — so a step-time regression names the
+phase that moved instead of one opaque host lap. Needs the ``xprof``
+package (TPU toolchain); without it the section degrades to a note.
 """
 
 from __future__ import annotations
@@ -117,10 +125,34 @@ def render(summary: dict) -> list:
     return lines
 
 
-def report(records: list) -> list:
+def render_phases(phases: dict) -> list:
+    """Text lines for a ``trace_phase.phase_times`` dict."""
+    lines = ["", "== device time by phase (profiled window) =="]
+    lines.append(f"{'phase':>10} {'ms/window':>12} {'share':>7}")
+    for ph, row in phases.items():
+        lines.append(f"{ph:>10} {row['us'] / 1e3:>12.2f} "
+                     f"{row['pct']:>6.1f}%")
+    return lines
+
+
+def device_phases(trace_dir: str):
+    """-> (phases dict or None, note lines). Degrades to a note when
+    xprof or the trace is unavailable."""
+    from tpunet.obs.trace_phase import hlo_stats_rows, phase_times
+    try:
+        return phase_times(hlo_stats_rows(trace_dir)), []
+    except Exception as e:  # missing xprof / empty trace / bad xplane
+        return None, ["", f"device-phase attribution unavailable: {e}"]
+
+
+def report(records: list, trace_dir: str = None) -> list:
     """Build the report lines from parsed metrics.jsonl records."""
     from tpunet.obs.summary import summarize
-    return render(summarize(records))
+    lines = render(summarize(records))
+    if trace_dir:
+        phases, notes = device_phases(trace_dir)
+        lines += render_phases(phases) if phases else notes
+    return lines
 
 
 def main(argv=None) -> int:
@@ -131,10 +163,21 @@ def main(argv=None) -> int:
                     help="emit the machine-readable summary (the "
                          "tpunet.obs.summary.summarize schema) instead "
                          "of the text tables")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="profiler trace dir (--profile-dir or "
+                         "<checkpoint-dir>/profile): adds measured "
+                         "device time by phase (fwd/bwd/optimizer/"
+                         "ema/eval); needs the xprof package")
     args = ap.parse_args(argv)
     path = args.path
     if os.path.isdir(path):
         path = os.path.join(path, "metrics.jsonl")
+        if args.trace is None:
+            # Convention: the windowed profiler writes under
+            # <checkpoint-dir>/profile when --profile-dir is unset.
+            cand = os.path.join(os.path.dirname(path), "profile")
+            if os.path.isdir(cand):
+                args.trace = cand
     if not os.path.isfile(path):
         print(f"no metrics.jsonl at {path}", file=sys.stderr)
         return 1
@@ -142,9 +185,13 @@ def main(argv=None) -> int:
     records = MetricsLogger.read_records(path)
     if args.json:
         from tpunet.obs.summary import summarize
-        print(json.dumps(summarize(records), indent=2))
+        out = summarize(records)
+        if args.trace:
+            phases, _notes = device_phases(args.trace)
+            out["device_phases"] = phases
+        print(json.dumps(out, indent=2))
         return 0
-    for line in report(records):
+    for line in report(records, trace_dir=args.trace):
         print(line)
     return 0
 

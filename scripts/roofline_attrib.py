@@ -14,8 +14,6 @@ Usage: python scripts/roofline_attrib.py [--batch 512] [--out PATH]
 from __future__ import annotations
 
 import argparse
-import glob
-import io
 import json
 import os
 import sys
@@ -83,18 +81,11 @@ def trace_step(trainer, gx, gy, steps: int, trace_dir: str) -> float:
 
 
 def hlo_stats(trace_dir: str):
-    """Parse the captured xplane into per-HLO-op row dicts via xprof's
-    hlo_stats tool (returns a gviz DataTable as JSON: cols + rows)."""
-    from xprof.convert import raw_to_tool_data as rtd
+    """Per-HLO-op row dicts from the captured xplane (shared parser:
+    tpunet/obs/trace_phase.py, also behind obs_report.py --trace)."""
+    from tpunet.obs.trace_phase import hlo_stats_rows
 
-    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                      recursive=True)
-    assert paths, f"no xplane under {trace_dir}"
-    data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
-    tab = json.loads(data.decode() if isinstance(data, bytes) else data)
-    labels = [c["label"] for c in tab["cols"]]
-    return [dict(zip(labels, [(c or {}).get("v") for c in r["c"]]))
-            for r in tab["rows"]]
+    return hlo_stats_rows(trace_dir)
 
 
 def main() -> None:
@@ -111,10 +102,23 @@ def main() -> None:
                          "was captured for the throughput numbers)")
     args = ap.parse_args()
 
+    bytes_breakdown = None
     if args.from_trace:
         trace_dir, wall, trainer = args.from_trace, None, None
     else:
         trainer, gx, gy = build_step(args.batch, args.image_size)
+        # Byte attribution from the optimized module text (same
+        # decomposition bench.py ships as bytes_per_image_breakdown);
+        # AOT-compiling here warms the executable the trace reuses.
+        try:
+            from tpunet.obs import hlo_bytes
+            from tpunet.utils.prng import step_key
+            compiled = trainer.train_step.lower(
+                trainer.state, gx, gy, step_key(0, 0)).compile()
+            bytes_breakdown = hlo_bytes.per_image_breakdown(
+                compiled.as_text(), args.batch)
+        except Exception as e:
+            print(f"# byte attribution unavailable: {e}", file=sys.stderr)
         trace_dir = tempfile.mkdtemp(prefix="tpunet-roofline-trace-")
         wall = trace_step(trainer, gx, gy, args.steps, trace_dir)
         print(f"# traced {args.steps} steps in {wall:.2f}s "
@@ -127,7 +131,7 @@ def main() -> None:
     # dir this run created, or skip closing the trainer's
     # checkpointer/threads.
     try:
-        _attrib_and_write(args, trace_dir, wall)
+        _attrib_and_write(args, trace_dir, wall, bytes_breakdown)
     finally:
         if args.from_trace or args.keep_trace:
             # Never delete a trace the CALLER owns (--from-trace) or
@@ -141,7 +145,10 @@ def main() -> None:
             trainer.close()
 
 
-def _attrib_and_write(args, trace_dir: str, wall) -> None:
+def _attrib_and_write(args, trace_dir: str, wall,
+                      bytes_breakdown=None) -> None:
+    from tpunet.obs.hlo_bytes import phase_of
+
     rows = hlo_stats(trace_dir)
 
     def f(row, name, default=0.0):
@@ -153,6 +160,7 @@ def _attrib_and_write(args, trace_dir: str, wall) -> None:
 
     by_cat = {}
     by_src = {}
+    by_phase = {}
     bw_weighted = 0.0
     hbm_time = 0.0
     ops = []
@@ -164,6 +172,11 @@ def _attrib_and_write(args, trace_dir: str, wall) -> None:
         src = (r.get("Framework op name") or "?").split("/")
         src = "/".join(src[1:3]) if len(src) > 2 else "/".join(src)
         by_src[src] = by_src.get(src, 0.0) + t
+        # and to the training phase (fwd / bwd / optimizer / ema) —
+        # the same classifier scripts/obs_report.py --trace uses, so
+        # the time and bytes tables split the step identically.
+        ph = phase_of(r.get("Framework op name") or "")
+        by_phase[ph] = by_phase.get(ph, 0.0) + t
         bw = f(r, "Measured memory BW (GiB/s)")
         if r.get("Bound by") == "HBM":
             hbm_time += t
@@ -196,6 +209,10 @@ def _attrib_and_write(args, trace_dir: str, wall) -> None:
         "hbm_bound_time_pct": round(100.0 * hbm_time / total, 2),
         "hbm_bound_mean_achieved_bw_gibs": round(
             bw_weighted / hbm_time, 1) if hbm_time else None,
+        "by_phase_pct": {
+            k: round(100.0 * v / total, 2)
+            for k, v in sorted(by_phase.items(), key=lambda kv: -kv[1])},
+        "bytes_per_image_breakdown": bytes_breakdown,
         "by_category_pct": {
             k: round(100.0 * v / total, 2)
             for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1])},

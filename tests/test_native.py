@@ -131,3 +131,54 @@ def test_lm_trainer_uses_native_loader_with_identical_metrics():
             trainer.close()
     assert results[True]["loss"] == results[False]["loss"]
     assert results[True]["count"] == results[False]["count"]
+
+
+def test_resume_falls_back_to_python_loader(tmp_path, monkeypatch, capsys):
+    """KNOWN BUG GUARD (ROADMAP): --resume + the native C++ prefetcher
+    crashed with glibc heap corruption on a single-core host. Until
+    root-caused, a resumed run must get the numpy loader (with a loud
+    warning), never a possible SIGSEGV; TPUNET_NATIVE_RESUME=1 is the
+    opt-back-in escape hatch."""
+    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
+                               ModelConfig, OptimConfig, TrainConfig)
+    from tpunet.train.loop import Trainer
+
+    def cfg(resume):
+        return TrainConfig(
+            epochs=1,
+            data=DataConfig(dataset="synthetic", batch_size=16,
+                            synthetic_train_size=32,
+                            synthetic_test_size=16, image_size=32,
+                            native_loader=True),
+            model=ModelConfig(width_mult=0.5, dtype="float32"),
+            optim=OptimConfig(),
+            mesh=MeshConfig(),
+            checkpoint=CheckpointConfig(directory=str(tmp_path),
+                                        save_best=False, save_last=False,
+                                        resume=resume),
+        )
+
+    monkeypatch.delenv("TPUNET_NATIVE_RESUME", raising=False)
+    fresh = Trainer(cfg(resume=False))
+    try:
+        assert fresh._prefetcher is not None  # fresh runs keep native
+    finally:
+        fresh.close()
+
+    resumed = Trainer(cfg(resume=True))
+    try:
+        assert resumed._prefetcher is None    # guarded fallback
+        out = capsys.readouterr().out
+        assert "TPUNET_NATIVE_RESUME" in out  # loud, actionable warning
+        # ...and the fallback epoch actually trains.
+        m = resumed.train_one_epoch(1)
+        assert m["count"] == 32
+    finally:
+        resumed.close()
+
+    monkeypatch.setenv("TPUNET_NATIVE_RESUME", "1")
+    forced = Trainer(cfg(resume=True))
+    try:
+        assert forced._prefetcher is not None  # escape hatch honored
+    finally:
+        forced.close()

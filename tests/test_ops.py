@@ -125,3 +125,93 @@ def test_model_flag_same_params_same_logits(monkeypatch):
     b = pal.apply(variables, x, train=False)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# IO-aware Pallas backward kernels (dx/dw): parity vs the XLA reference
+# transpose, in interpret mode on CPU. Non-slow on small shapes (tier-1
+# runs these); the full MobileNetV2 shape sweep is slow-marked.
+# ---------------------------------------------------------------------------
+
+def _bwd_pair(h, w_, c, stride, seed, dtype=jnp.float32):
+    kx, kw, kg = jax.random.split(jax.random.PRNGKey(seed), 3)
+    x = jax.random.normal(kx, (2, h, w_, c), dtype)
+    w = jax.random.normal(kw, (3, 3, c), dtype)
+    ho = (h - 1) // stride + 1
+    wo = (w_ - 1) // stride + 1
+    g = jax.random.normal(kg, (2, ho, wo, c), dtype)
+
+    def vjp_of(f):
+        _, vjp = jax.vjp(lambda xx, ww: f(xx, ww), x, w)
+        return vjp(g)
+
+    got = vjp_of(lambda xx, ww: depthwise_conv3x3(xx, ww, stride, True))
+    want = vjp_of(lambda xx, ww: depthwise_conv3x3_reference(xx, ww,
+                                                            stride))
+    return got, want
+
+
+# Odd H/W, non-square, channel counts off the 128-lane multiple — the
+# property grid the stripe/halo + in-VMEM dilation logic must survive.
+@pytest.mark.parametrize("h,w,c,stride", [
+    (8, 8, 16, 1),
+    (8, 8, 16, 2),
+    (7, 7, 24, 1),      # odd H/W stride 1
+    (7, 7, 24, 2),      # odd H/W stride 2 (dx phantom-row slice)
+    (7, 9, 40, 1),      # non-square, off-lane channels
+    (9, 7, 40, 2),
+    (5, 5, 8, 2),
+    (4, 6, 3, 2),       # tiny + odd channel count
+])
+def test_backward_kernels_match_reference(h, w, c, stride):
+    (gx, gw), (rx, rw) = _bwd_pair(h, w, c, stride, seed=h * 31 + stride)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_backward_kernels_bf16_accumulate_f32():
+    """bf16 inputs: gradients come back bf16 but match the f32
+    reference within bf16 rounding (the kernels accumulate in f32)."""
+    kx, kw, kg = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (2, 8, 8, 32))
+    w = jax.random.normal(kw, (3, 3, 32))
+    g = jax.random.normal(kg, (2, 4, 4, 32))
+
+    def vjp_of(f, x, w, g):
+        _, vjp = jax.vjp(f, x, w)
+        return vjp(g)
+
+    gx, gw = vjp_of(
+        lambda xx, ww: depthwise_conv3x3(xx, ww, 2, True),
+        x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+        g.astype(jnp.bfloat16))
+    assert gx.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+    rx, rw = vjp_of(
+        lambda xx, ww: depthwise_conv3x3_reference(xx, ww, 2), x, w, g)
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx), rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(gw, np.float32),
+                               np.asarray(rw), rtol=5e-2, atol=5e-2)
+
+
+def test_backward_reference_escape_hatch(monkeypatch):
+    """TPUNET_DEPTHWISE_REF_BWD=1 routes backward through the XLA
+    reference transpose even when the kernels are requested."""
+    monkeypatch.setenv("TPUNET_DEPTHWISE_REF_BWD", "1")
+    (gx, gw), (rx, rw) = _bwd_pair(6, 6, 8, 1, seed=4)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("h,c,stride", MOBILENET_SHAPES)
+def test_backward_kernels_mobilenet_shapes(h, c, stride):
+    (gx, gw), (rx, rw) = _bwd_pair(h, h, c, stride, seed=c)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=5e-4, atol=5e-4)

@@ -180,6 +180,25 @@ class ModelConfig:
     # backend; parameter trees are identical either way, so the flag
     # can be flipped on existing checkpoints.
     use_pallas_depthwise: bool = False
+    # MobileNetV2 HBM-traffic levers (tpunet/models/mobilenetv2.py;
+    # the step is bandwidth-bound at ~5% MFU — see docs/performance.md
+    # for the bytes/image budget these move):
+    # fused_bn (default ON): conv -> BN -> ReLU6 epilogue as one
+    # fusable region (single-pass batch stats, per-channel FMA +
+    # clamp, bf16 residency) instead of nn.BatchNorm + separate clamp.
+    # Measured -8.4% xla_bytes_accessed/image on the CPU-compiled
+    # 224px step; same variable tree, so flippable on checkpoints.
+    fused_bn: bool = True
+    # block_remat (default OFF): saved-residual policy for the
+    # inverted-residual blocks — keep only conv outputs + (C,)-sized
+    # BN stats as residuals and recompute the elementwise epilogues in
+    # the backward replay (jax.checkpoint save_only_these_names).
+    # Default off because the CPU-compiled module measures MORE bytes
+    # accessed with it on (the replay's recomputes don't all fuse);
+    # the per-op byte attribution (bench.py bytes_per_image_breakdown)
+    # is the tool for deciding it per backend — flip with
+    # --block-remat and compare on real TPU.
+    block_remat: bool = False
 
 
 @dataclass(frozen=True)
@@ -295,6 +314,13 @@ class ObsConfig:
     # switch from exact percentiles to seeded reservoir sampling
     # (count/mean stay exact; the summary carries ``approx: 1``).
     histogram_max_samples: int = 65536
+    # --obs-hbm-attrib: once, at the first step, AOT-compile the train
+    # step and decompose its cost-analysis HBM bytes by op category
+    # into the hbm_bytes_per_image_* gauge family
+    # (tpunet/obs/hlo_bytes.py). Off by default: the extra lowering is
+    # one redundant compile (cheap under the persistent cache, not
+    # free).
+    hbm_attrib: bool = False
     # -- run-health watchdog (tpunet/obs/health.py) -----------------
     # A step slower than stall_factor x the rolling median (and at
     # least stall_min_s) emits a step_stall obs_alert. 0 disables.
@@ -594,6 +620,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--obs-step-every", type=int, default=None,
                    help="emit a per-step obs_step record every N "
                         "steps (0 = per-epoch obs records only)")
+    p.add_argument("--obs-hbm-attrib", action="store_true",
+                   help="decompose the compiled train step's HBM "
+                        "bytes by op category into the "
+                        "hbm_bytes_per_image_* gauges once at the "
+                        "first step (one extra AOT lowering)")
     p.add_argument("--statsd", default=None, metavar="HOST:PORT",
                    help="stream obs records as statsd/UDP gauges to "
                         "this endpoint (non-blocking: bounded queue + "
@@ -652,6 +683,20 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="route 3x3 depthwise convs through the Pallas "
                         "kernel (default off: slower than XLA's conv "
                         "emitter on v5e, kept for experimentation)")
+    p.add_argument("--fused-bn", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="MobileNetV2: conv->BN->ReLU6 epilogue as one "
+                        "fusable region (default on; --no-fused-bn "
+                        "restores the nn.BatchNorm + separate clamp "
+                        "path, same parameters)")
+    p.add_argument("--block-remat", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="MobileNetV2: recompute inverted-residual "
+                        "elementwise epilogues in backward, saving "
+                        "only conv outputs + BN stats as residuals "
+                        "(default off: measured as MORE bytes accessed "
+                        "on the CPU backend; compare per backend via "
+                        "bench.py's bytes_per_image_breakdown)")
     return p
 
 
@@ -664,6 +709,8 @@ def config_from_args(argv=None) -> TrainConfig:
         obs = dataclasses.replace(obs, enabled=False)
     if args.obs_step_every is not None:
         obs = dataclasses.replace(obs, step_records_every=args.obs_step_every)
+    if args.obs_hbm_attrib:
+        obs = dataclasses.replace(obs, hbm_attrib=True)
     if args.profile_start_step is not None:
         obs = dataclasses.replace(obs,
                                   profile_start_step=args.profile_start_step)
@@ -759,6 +806,10 @@ def config_from_args(argv=None) -> TrainConfig:
     if args.pallas_depthwise is not None:
         model = dataclasses.replace(model,
                                     use_pallas_depthwise=args.pallas_depthwise)
+    if args.fused_bn is not None:
+        model = dataclasses.replace(model, fused_bn=args.fused_bn)
+    if args.block_remat is not None:
+        model = dataclasses.replace(model, block_remat=args.block_remat)
     if args.dtype is not None:
         model = dataclasses.replace(model, dtype=args.dtype)
     if args.lr is not None:

@@ -79,8 +79,82 @@ class PallasDepthwise(nn.Module):
         return depthwise_conv3x3(x.astype(self.dtype), w, self.stride)
 
 
+class FusedBNAct(nn.Module):
+    """Train-mode BatchNorm + optional ReLU6 as ONE fusable region.
+
+    Byte-level restructuring of ``nn.BatchNorm`` + separate clamp for
+    an HBM-bound model (same math, same variable layout — 'scale'/
+    'bias' params and 'mean'/'var' float32 batch_stats — so
+    checkpoints and converted torch weights are interchangeable with
+    the ``nn.BatchNorm`` path):
+
+    - the batch-stat reduction is a single pass (mean of x and of x*x
+      reduced together, Var = E[x^2] - E[x]^2 like flax's
+      use_fast_variance) — one read of the activation;
+    - normalize, scale/shift, and clamp are folded into one
+      per-channel FMA + clamp (y = x * inv + shift with inv/shift
+      precomputed per channel in f32), one read + one write of the
+      activation with no separate normalized-activation round-trip;
+    - bf16 residency: the written activation is exactly
+      ``self.dtype`` (asserted), statistics stay f32.
+
+    The remaining second read of the activation (stats pass +
+    normalize pass) is inherent to training BatchNorm; everything else
+    is elementwise in one fusable region.
+    """
+
+    act: bool = True
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,),
+                           self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (c,),
+                          self.param_dtype)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32), (c,))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32), (c,))
+        if train:
+            axes = tuple(range(x.ndim - 1))
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axes)
+            # Same fusion reduces both sums in one pass over x.
+            var = jnp.maximum(0.0, jnp.mean(xf * xf, axes) - mean * mean)
+            # Named for the block-remat saved-residual policy: the
+            # (C,)-sized stats are saved so the backward replay never
+            # re-reduces a full activation (see MobileNetV2.__call__).
+            from jax.ad_checkpoint import checkpoint_name
+            mean = checkpoint_name(mean, "tpunet_bn_stats")
+            var = checkpoint_name(var, "tpunet_bn_stats")
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        else:
+            mean, var = ra_mean.value, ra_var.value
+        inv = jax.lax.rsqrt(var + self.epsilon) * scale.astype(jnp.float32)
+        shift = bias.astype(jnp.float32) - mean * inv
+        y = x.astype(jnp.float32) * inv + shift
+        if self.act:
+            y = jnp.minimum(jnp.maximum(y, 0.0), 6.0)  # ReLU6
+        y = y.astype(self.dtype)
+        assert y.dtype == jnp.dtype(self.dtype)  # bf16 residency
+        return y
+
+
 class ConvBN(nn.Module):
-    """Conv + BatchNorm (+ optional ReLU6), the MobileNetV2 building unit."""
+    """Conv + BatchNorm (+ optional ReLU6), the MobileNetV2 building unit.
+
+    ``fused_bn`` (default) expresses BN + clamp through ``FusedBNAct``
+    — one fusable epilogue region; off, the original ``nn.BatchNorm``
+    + separate ReLU6 path (bit-compatible variable trees either way).
+    """
 
     features: int
     kernel: int = 3
@@ -88,6 +162,7 @@ class ConvBN(nn.Module):
     groups: int = 1
     act: bool = True
     use_pallas: bool = False
+    fused_bn: bool = True
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -111,6 +186,18 @@ class ConvBN(nn.Module):
                 param_dtype=self.param_dtype,
                 name="conv",
             )(x)
+        # Conv outputs are the ONLY activation-sized residuals the
+        # block-remat policy keeps: the forward materializes them
+        # regardless (they feed the next conv), so saving them is
+        # free, and the backward replay recomputes just the
+        # elementwise BN/ReLU6 epilogues from them (no conv re-runs).
+        from jax.ad_checkpoint import checkpoint_name
+        x = checkpoint_name(x, "tpunet_convout")
+        if self.fused_bn:
+            return FusedBNAct(act=self.act, momentum=0.9, epsilon=1e-5,
+                              dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              name="bn")(x, train)
         x = nn.BatchNorm(
             use_running_average=not train,
             momentum=0.9,
@@ -131,6 +218,7 @@ class InvertedResidual(nn.Module):
     stride: int
     expand_ratio: int
     use_pallas: bool = False
+    fused_bn: bool = True
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -140,13 +228,15 @@ class InvertedResidual(nn.Module):
         hidden = in_features * self.expand_ratio
         y = x
         if self.expand_ratio != 1:
-            y = ConvBN(hidden, kernel=1, dtype=self.dtype,
+            y = ConvBN(hidden, kernel=1, fused_bn=self.fused_bn,
+                       dtype=self.dtype,
                        param_dtype=self.param_dtype, name="expand")(y, train)
         y = ConvBN(hidden, kernel=3, stride=self.stride, groups=hidden,
-                   use_pallas=self.use_pallas, dtype=self.dtype,
-                   param_dtype=self.param_dtype,
+                   use_pallas=self.use_pallas, fused_bn=self.fused_bn,
+                   dtype=self.dtype, param_dtype=self.param_dtype,
                    name="depthwise")(y, train)
-        y = ConvBN(self.features, kernel=1, act=False, dtype=self.dtype,
+        y = ConvBN(self.features, kernel=1, act=False,
+                   fused_bn=self.fused_bn, dtype=self.dtype,
                    param_dtype=self.param_dtype, name="project")(y, train)
         if self.stride == 1 and in_features == self.features:
             y = y + x
@@ -165,6 +255,8 @@ class MobileNetV2(nn.Module):
     width_mult: float = 1.0
     dropout_rate: float = 0.2
     use_pallas: bool = False
+    fused_bn: bool = True
+    block_remat: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -172,20 +264,39 @@ class MobileNetV2(nn.Module):
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         stem_ch = _make_divisible(32 * self.width_mult)
-        x = ConvBN(stem_ch, kernel=3, stride=2, dtype=self.dtype,
+        x = ConvBN(stem_ch, kernel=3, stride=2, fused_bn=self.fused_bn,
+                   dtype=self.dtype,
                    param_dtype=self.param_dtype, name="stem")(x, train)
+        # Saved-residual policy: rematerialize each inverted-residual
+        # block in the backward pass saving ONLY conv outputs (which
+        # the forward materializes anyway — they feed the next conv)
+        # and the (C,)-sized BN batch stats. The BN/ReLU6 epilogue
+        # intermediates never round-trip through HBM as autodiff
+        # residuals — the backward replay recomputes them elementwise
+        # from the saved conv outputs (fusing into the backward
+        # consumers), and no convolution is ever re-executed (the
+        # nothing_saveable policy would re-run and re-WRITE every conv
+        # in the replay — measurably more bytes, not fewer). Parameter
+        # trees are identical with the flag off.
+        Block = InvertedResidual
+        if self.block_remat:
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "tpunet_convout", "tpunet_bn_stats")
+            Block = nn.remat(InvertedResidual, static_argnums=(2,),
+                             policy=policy)
         idx = 0
         for t, c, n, s in INVERTED_RESIDUAL_SETTINGS:
             out_ch = _make_divisible(c * self.width_mult)
             for i in range(n):
-                x = InvertedResidual(
+                x = Block(
                     out_ch, stride=s if i == 0 else 1, expand_ratio=t,
-                    use_pallas=self.use_pallas,
+                    use_pallas=self.use_pallas, fused_bn=self.fused_bn,
                     dtype=self.dtype, param_dtype=self.param_dtype,
                     name=f"block{idx:02d}")(x, train)
                 idx += 1
         head_ch = _make_divisible(1280 * max(1.0, self.width_mult))
-        x = ConvBN(head_ch, kernel=1, dtype=self.dtype,
+        x = ConvBN(head_ch, kernel=1, fused_bn=self.fused_bn,
+                   dtype=self.dtype,
                    param_dtype=self.param_dtype, name="head")(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool, NHWC -> NC
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
@@ -203,6 +314,8 @@ def create_model(cfg: ModelConfig) -> MobileNetV2:
         width_mult=cfg.width_mult,
         dropout_rate=cfg.dropout_rate,
         use_pallas=cfg.use_pallas_depthwise,
+        fused_bn=cfg.fused_bn,
+        block_remat=cfg.block_remat,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),
     )

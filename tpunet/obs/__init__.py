@@ -143,6 +143,17 @@ class Observability:
     def add_sink(self, sink) -> None:
         self.registry.add_sink(sink)
 
+    def set_hbm_breakdown(self, per_image: dict) -> None:
+        """Mirror a bytes/image-by-category attribution
+        (tpunet/obs/hlo_bytes.per_image_breakdown) into the
+        ``hbm_bytes_per_image_*`` gauge family, so exporters ship it
+        and ``--obs-rule 'hbm_bytes_per_image_total > N'`` predicates
+        can page on a byte regression in a live run."""
+        if not self.hot or not per_image:
+            return
+        from tpunet.obs.hlo_bytes import emit_gauges
+        emit_gauges(self.registry, per_image)
+
     def set_flops_per_unit(self, flops: float) -> None:
         self._flops_per_unit = float(flops)
 

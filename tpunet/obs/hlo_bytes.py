@@ -1,0 +1,304 @@
+"""Per-op HBM byte attribution from compiled (post-fusion) HLO text.
+
+XLA's ``cost_analysis()["bytes accessed"]`` is one opaque number; this
+module decomposes it so a bytes/image regression names the op category
+that moved. The model is the same one XLA's cost analysis uses: every
+top-level (non-fused) instruction in the optimized module reads its
+operands from HBM and writes its output to HBM — instructions INSIDE a
+fusion stay on-chip and cost nothing. Parsing the post-optimization
+text (``compiled.as_text()``) means the counts reflect what the
+compiler actually scheduled, remat and epilogue fusion included; the
+per-device SPMD module is what prints, so counts are per chip, like
+``cost_analysis``.
+
+Categories (the byte-amplification suspects of the HBM-bound
+MobileNetV2 step):
+
+- ``conv_fwd`` / ``conv_bwd``  — convolutions (and conv-rooted
+  fusions); bwd = ops under a ``transpose(...)`` autodiff scope.
+- ``matmul``     — dot/dot-rooted fusions (the classifier head).
+- ``bn``         — ops in a ``/bn/`` module scope: batch-stat
+  reductions + the normalize/scale/shift/clamp epilogue regions.
+- ``optimizer``  — the ``tpunet_optimizer`` / ``tpunet_ema`` named
+  scopes (Adam moments, EMA).
+- ``augment``    — the ``tpunet_augment`` named scope: the on-device
+  input pipeline (resize/crop/rotate/jitter), a measured ~20%% of the
+  round-4 step — kept distinct from model fwd work.
+- ``copy_pad``   — layout traffic: copies, pads, transposes, slices,
+  concats, converts at top level (or fusions rooted there).
+- ``reduce``     — non-BN reductions (pool, loss, metrics).
+- ``collective`` — cross-chip all-reduce/gather/permute traffic.
+- ``elementwise``— everything else (augment chains, losses, adds).
+
+``phase_of`` / ``is_backward`` classify framework op names by training
+phase; scripts/obs_report.py reuses them for device-TIME attribution
+from profiler traces, so the bytes and time tables split the step the
+same way.
+
+Known approximations (documented, stable across runs, so the >5%%
+regression gate is still meaningful): ``while``/``conditional`` bodies
+are counted once (the bench train step is straight-line at
+grad_accum=1); CPU-backend ``call`` thunks are traversed into.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "fp8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# Produce/consume no HBM traffic of their own (aliases, metadata ops).
+_SKIP_OPS = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "after-all", "partition-id", "replica-id", "domain", "opt-barrier",
+}
+
+# Traverse instead of count: their cost is the instructions they run.
+_CALL_OPS = {"call", "while", "conditional", "async-start"}
+
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]{0,15})\[([0-9,]*)\]")
+_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s+=\s+(.*)$")
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s+\(.*\{\s*$")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_CALLED_RE = re.compile(
+    r"(?:to_apply|body|condition|true_computation|false_computation)"
+    r"=%?([\w.\-]+)|branch_computations=\{([^}]*)\}")
+
+_COPY_ROOTS = {
+    "copy", "pad", "transpose", "slice", "dynamic-slice", "dynamic_slice",
+    "dynamic-update-slice", "dynamic_update_slice", "concatenate",
+    "reshape", "convert", "gather", "scatter", "squeeze", "broadcast",
+    "broadcast_in_dim", "rev", "copy-start",
+}
+_COLLECTIVES = {
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast", "psum", "all_gather",
+    "all_to_all", "ppermute",
+}
+
+CATEGORIES = ("conv_fwd", "conv_bwd", "matmul", "bn", "augment",
+              "optimizer", "copy_pad", "reduce", "collective",
+              "elementwise")
+
+
+def _shape_bytes(type_str: str) -> int:
+    """Total bytes of an HLO type string (tuples sum their elements)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        nbytes = _DTYPE_BYTES.get(dtype)
+        if nbytes is None:
+            continue  # token[] / opaque[] / unknown
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * nbytes
+    return total
+
+
+def is_backward(op_name: str) -> bool:
+    """True when the framework op name sits under an autodiff
+    transpose scope (cotangent computation, remat replays included)."""
+    return "transpose(" in (op_name or "")
+
+
+def phase_of(op_name: str) -> str:
+    """Training phase of a framework op name: fwd / bwd / optimizer /
+    ema / eval / other — the split scripts/obs_report.py reports
+    device time under."""
+    name = op_name or ""
+    if "tpunet_optimizer" in name:
+        return "optimizer"
+    if "tpunet_ema" in name:
+        return "ema"
+    if "tpunet_eval_forward" in name:
+        return "eval"
+    if "tpunet_augment" in name:
+        return "augment"
+    if "tpunet_fwd_bwd" in name or "jvp(" in name:
+        return "bwd" if is_backward(name) else "fwd"
+    return "other"
+
+
+def _leaf_primitive(op_name: str) -> str:
+    """Last path element of a framework op name ('.../bn/reduce_sum'
+    -> 'reduce_sum')."""
+    return (op_name or "").rsplit("/", 1)[-1]
+
+
+def categorize(opcode: str, op_name: str) -> str:
+    name = op_name or ""
+    phase = phase_of(name)
+    if phase in ("optimizer", "ema"):
+        return "optimizer"
+    if "tpunet_augment" in name:
+        # Before the conv/dot checks: the rotation's shear matmul
+        # banks are dots, but they are input-pipeline work.
+        return "augment"
+    leaf = _leaf_primitive(name)
+    if opcode == "convolution" or "conv_general_dilated" in leaf:
+        return "conv_bwd" if is_backward(name) else "conv_fwd"
+    if opcode == "dot" or leaf.startswith("dot_general"):
+        return "matmul"
+    # Multi-chip TPU modules print collectives as async pairs
+    # (all-reduce-start / all-reduce-done); the -start carries the
+    # traffic (the -done is skipped in the walk as a completion
+    # marker).
+    base_op = opcode[:-6] if opcode.endswith("-start") else opcode
+    if base_op in _COLLECTIVES or leaf in _COLLECTIVES:
+        return "collective"
+    if "/bn/" in name:
+        return "bn"
+    if opcode in _COPY_ROOTS:
+        return "copy_pad"
+    if opcode in ("reduce", "reduce-window") or leaf.startswith("reduce"):
+        return "reduce"
+    return "elementwise"
+
+
+def _computations(hlo_text: str) -> Tuple[Optional[str], Dict[str, List[str]]]:
+    """Split module text into {computation name: [instruction lines]};
+    returns (entry_name, comps)."""
+    comps: Dict[str, List[str]] = {}
+    entry = None
+    current: Optional[List[str]] = None
+    for line in hlo_text.splitlines():
+        m = _COMP_RE.match(line)
+        if m:
+            name = m.group(2)
+            if m.group(1):
+                entry = name
+            current = comps.setdefault(name, [])
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        if current is not None and _INSTR_RE.match(line):
+            current.append(line)
+    return entry, comps
+
+
+def _parse_instr(line: str) -> Optional[Tuple[str, int, int, str]]:
+    """-> (opcode, out_bytes, operand_bytes, op_name) or None."""
+    m = _INSTR_RE.match(line)
+    if not m:
+        return None
+    rest = m.group(1)
+    # Output type: either a tuple "(...)" or a single token.
+    if rest.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+        type_str, rest = rest[:i + 1], rest[i + 1:]
+    else:
+        sp = rest.find(" ")
+        if sp < 0:
+            return None
+        type_str, rest = rest[:sp], rest[sp:]
+    rest = rest.lstrip()
+    om = re.match(r"([\w\-]+)\(", rest)
+    if not om:
+        return None
+    opcode = om.group(1)
+    # Operand segment: the matching paren after the opcode. metadata/
+    # attrs follow it, so quoted strings never reach the shape regex.
+    depth, start = 0, om.end() - 1
+    end = len(rest)
+    for i in range(start, len(rest)):
+        if rest[i] == "(":
+            depth += 1
+        elif rest[i] == ")":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    args = rest[start + 1:end]
+    op_name = ""
+    nm = _OPNAME_RE.search(rest[end:])
+    if nm:
+        op_name = nm.group(1)
+    return (opcode, _shape_bytes(type_str),
+            _shape_bytes(args) if opcode != "constant" else 0, op_name)
+
+
+def instruction_bytes(hlo_text: str) -> Iterator[Tuple[str, str, int, str]]:
+    """Yield (opcode, category, bytes, op_name) per counted top-level
+    instruction, walking ENTRY and any called (non-fused) bodies."""
+    entry, comps = _computations(hlo_text)
+    if entry is None:
+        return
+    seen = set()
+
+    def walk(name: str) -> Iterator[Tuple[str, str, int, str]]:
+        if name in seen or name not in comps:
+            return
+        seen.add(name)
+        for line in comps[name]:
+            parsed = _parse_instr(line)
+            if parsed is None:
+                continue
+            opcode, out_b, in_b, op_name = parsed
+            if opcode in _SKIP_OPS:
+                continue
+            if opcode.endswith("-done"):
+                # Async completion markers (all-reduce-done,
+                # copy-done, async-done): the traffic was counted at
+                # the matching -start; counting both halves would
+                # double-charge every collective/async copy.
+                continue
+            if opcode in _CALL_OPS:
+                for target in _called_comps(line):
+                    yield from walk(target)
+                continue
+            yield opcode, categorize(opcode, op_name), out_b + in_b, op_name
+
+    yield from walk(entry)
+
+
+def _called_comps(line: str) -> List[str]:
+    out = []
+    for single, many in _CALLED_RE.findall(line):
+        if single:
+            out.append(single)
+        if many:
+            out.extend(t.strip().lstrip("%") for t in many.split(","))
+    return out
+
+
+def breakdown(hlo_text: str) -> Dict[str, float]:
+    """{category: total bytes} over the module, plus 'total'."""
+    by_cat = {c: 0.0 for c in CATEGORIES}
+    total = 0.0
+    for _opcode, cat, nbytes, _name in instruction_bytes(hlo_text):
+        by_cat[cat] = by_cat.get(cat, 0.0) + nbytes
+        total += nbytes
+    out = {k: v for k, v in by_cat.items() if v}
+    out["total"] = total
+    return out
+
+
+def per_image_breakdown(hlo_text: str, images: int) -> Dict[str, int]:
+    """Bytes per image by category ('total' included), from the
+    per-device module text and the PER-DEVICE image count of one
+    execution."""
+    return {k: int(round(v / max(1, images)))
+            for k, v in breakdown(hlo_text).items()}
+
+
+def emit_gauges(registry, per_image: Dict[str, int]) -> None:
+    """Mirror a per-image breakdown into the ``hbm_bytes_per_image_*``
+    gauge family (snapshot keys usable in --obs-rule predicates)."""
+    for cat, val in per_image.items():
+        registry.gauge(f"hbm_bytes_per_image_{cat}").set(float(val))

@@ -1,0 +1,73 @@
+"""Device-time attribution by training phase from a profiler trace.
+
+The windowed profiler (tpunet/obs/spans.py) captures an xplane under
+``--profile-dir``; xprof's ``hlo_stats`` tool turns it into per-HLO-op
+rows with measured device self time. This module groups those rows by
+the training PHASE the op belongs to — fwd / bwd / optimizer / ema /
+eval — using the same ``jax.named_scope`` markers the jitted steps
+plant (``tpunet_fwd_bwd`` etc., classified by
+``tpunet.obs.hlo_bytes.phase_of``), so a step-time regression names
+the phase that moved instead of one opaque host lap.
+
+``hlo_stats_rows`` needs the optional ``xprof`` package (present on
+the TPU toolchain, not in minimal CPU installs) — callers get a clear
+ImportError. ``phase_times`` is pure and unit-tested without it.
+Consumers: scripts/obs_report.py ``--trace`` and
+scripts/roofline_attrib.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List
+
+from tpunet.obs.hlo_bytes import phase_of
+
+PHASES = ("augment", "fwd", "bwd", "optimizer", "ema", "eval", "other")
+
+
+def hlo_stats_rows(trace_dir: str) -> List[dict]:
+    """Parse the captured xplane(s) under ``trace_dir`` into per-HLO-op
+    row dicts via xprof's hlo_stats tool (a gviz DataTable: one dict
+    per op with 'Framework op name', 'Total self time (us)', ...)."""
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except ImportError as e:
+        raise ImportError(
+            "per-phase device-time attribution needs the 'xprof' "
+            "package (ships with the TPU toolchain); host-lap timings "
+            "in obs_epoch records remain available without it") from e
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir!r} "
+                                "(did the profile window run?)")
+    data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
+    tab = json.loads(data.decode() if isinstance(data, bytes) else data)
+    labels = [c["label"] for c in tab["cols"]]
+    return [dict(zip(labels, [(c or {}).get("v") for c in r["c"]]))
+            for r in tab["rows"]]
+
+
+def phase_times(rows: List[dict]) -> Dict[str, Dict[str, float]]:
+    """Group measured device self time by training phase.
+
+    -> {phase: {"us": total self time, "pct": share of profiled
+    time}}, phases ordered by time. Rows without a framework op name
+    (infeed, runtime gaps) land in 'other'.
+    """
+    by_phase: Dict[str, float] = {}
+    for r in rows:
+        try:
+            t = float(r.get("Total self time (us)") or 0.0)
+        except (TypeError, ValueError):
+            t = 0.0
+        if not t:
+            continue
+        ph = phase_of(r.get("Framework op name") or "")
+        by_phase[ph] = by_phase.get(ph, 0.0) + t
+    total = sum(by_phase.values()) or 1.0
+    return {ph: {"us": round(us, 1), "pct": round(100.0 * us / total, 2)}
+            for ph, us in sorted(by_phase.items(), key=lambda kv: -kv[1])}

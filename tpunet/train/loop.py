@@ -12,6 +12,7 @@ from epoch 0, SURVEY.md section 5).
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -195,11 +196,35 @@ class Trainer:
             # datasets carry [B, T] segment ids in the label slot, which
             # the prefetcher's scalar-label ABI doesn't cover — numpy
             # path there.
-            from tpunet.data import native
-            if native.available():
-                local = cfg.data.batch_size // jax.process_count()
-                self._prefetcher = native.NativePrefetcher(
-                    self.train_x, self.train_y.astype(np.int32), local)
+            if cfg.checkpoint.resume and not os.environ.get(
+                    "TPUNET_NATIVE_RESUME"):
+                # KNOWN BUG GUARD (ROADMAP): --resume with the native
+                # C++ prefetcher has crashed with glibc heap corruption
+                # ("corrupted double-linked list" / SIGSEGV) right
+                # after "Starting training..." on a single-core CPU
+                # host (PR-3 tree; fresh runs and --no-native-loader
+                # resumes are fine, and the restore itself completes).
+                # Audit of tpunet/data/native.py + cxx/batcher.cc found
+                # no shutdown/re-init lifetime hole (start_epoch joins
+                # the old worker before rebinding state; the epoch
+                # index is copied host-side before the call returns;
+                # Python keeps the zero-copy row/label arrays alive for
+                # the prefetcher's lifetime), and the crash does not
+                # reproduce on this tree — but until it is root-caused,
+                # a resumed run gets the numpy loader instead of a
+                # possible SIGSEGV. TPUNET_NATIVE_RESUME=1 opts back
+                # in (e.g. to bisect on the affected host).
+                log0("WARNING: --resume currently falls back to the "
+                     "numpy host loader (known native-prefetcher heap "
+                     "corruption on resume, see ROADMAP); set "
+                     "TPUNET_NATIVE_RESUME=1 to force the native path")
+            else:
+                from tpunet.data import native
+                if native.available():
+                    local = cfg.data.batch_size // jax.process_count()
+                    self._prefetcher = native.NativePrefetcher(
+                        self.train_x, self.train_y.astype(np.int32),
+                        local)
 
         self._schedule = lr_schedule(cfg.optim, self.spe, cfg.epochs)
         # Observability (tpunet/obs/): per-step timing + stall split +
@@ -238,6 +263,8 @@ class Trainer:
         self.start_epoch = 1
         self.best_acc = 0.0
         self.history: List[Dict[str, float]] = []
+        self._hbm_attrib_pending = bool(cfg.obs.enabled
+                                        and cfg.obs.hbm_attrib)
         if cfg.checkpoint.resume:
             self._try_resume()
 
@@ -363,6 +390,9 @@ class Trainer:
             if self._stop_agreed():
                 break  # preemption: stop at a step boundary
             rng = step_key(cfg.seed, self.global_step)
+            if self._hbm_attrib_pending:
+                self._hbm_attrib_pending = False
+                self._attribute_hbm_bytes(bx, by, rng)
             if obs_hot:
                 # Profile-window edge check; the sync fence runs only
                 # on the two steps where a window opens/closes. The
@@ -405,6 +435,26 @@ class Trainer:
                      f"loss {sm['loss']:.4f} acc {sm['accuracy']:.4f} "
                      f"lr {lr:.3e}")
         return M.summarize(acc if acc is not None else M.zeros_metrics())
+
+    def _attribute_hbm_bytes(self, bx, by, rng) -> None:
+        """--obs-hbm-attrib: once, before the first step, AOT-lower
+        the train step and mirror the per-op-category decomposition of
+        its cost-analysis HBM bytes into the hbm_bytes_per_image_*
+        gauges (tpunet/obs/hlo_bytes.py). The extra lowering compiles
+        nothing new when the persistent compile cache is warm; any
+        failure is logged and training proceeds (attribution is
+        observability, never a reason to stop a run)."""
+        try:
+            from tpunet.obs import hlo_bytes
+            gx, gy = shard_host_batch(self.mesh, bx, by.astype(np.int32))
+            compiled = self.train_step.lower(
+                self.state, gx, gy, rng).compile()
+            per_chip = max(1, self.cfg.data.batch_size
+                           // jax.device_count())
+            self.obs.set_hbm_breakdown(hlo_bytes.per_image_breakdown(
+                compiled.as_text(), per_chip))
+        except Exception as e:  # pragma: no cover - backend-specific
+            log0(f"hbm byte attribution failed: {e}")
 
     def current_lr(self) -> float:
         """The LR the NEXT step will use (host-side schedule lookup)."""

@@ -184,11 +184,16 @@ def make_train_step(data_cfg: DataConfig,
             # streams (and thus seed-for-seed runs) identical to
             # configs that predate the mixup option.
             aug_rng, dropout_rng = jax.random.split(rng)
-        images = augment(aug_rng, images_u8)
-        if mixing:
-            images, labels_b, lam = mixup_cutmix(
-                mix_rng, images, labels,
-                data_cfg.mixup_alpha, data_cfg.cutmix_alpha)
+        # Named scope: the on-device augmentation gets its own bucket
+        # in the byte/time attributions (tpunet/obs/hlo_bytes.py) —
+        # round 5 found ~20% of the step hiding here, so it must not
+        # blur into the generic fwd/elementwise categories.
+        with jax.named_scope("tpunet_augment"):
+            images = augment(aug_rng, images_u8)
+            if mixing:
+                images, labels_b, lam = mixup_cutmix(
+                    mix_rng, images, labels,
+                    data_cfg.mixup_alpha, data_cfg.cutmix_alpha)
 
         def loss_fn(params):
             # mutable=["batch_stats"] is harmless for models without
