@@ -125,7 +125,31 @@ def _note(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def _measure(per_chip_batch: int, timed: int = 24, image_size: int = 224):
+def _model_overrides(argv) -> dict:
+    """ModelConfig overrides from the variant flags, so lever A/Bs are
+    one command each (docs/performance.md "A/B workflow"):
+    --block-remat / --no-block-remat, --fused-ir / --no-fused-ir,
+    --fused-bn / --no-fused-bn, --pallas-depthwise. Repeated flags are
+    last-wins in argv order, matching the train CLI's argparse
+    BooleanOptionalAction (so a sweep script may append an override to
+    a base command)."""
+    spec = {}
+    for flag, field in (("block-remat", "block_remat"),
+                        ("fused-ir", "fused_ir"),
+                        ("fused-bn", "fused_bn"),
+                        ("pallas-depthwise", "use_pallas_depthwise")):
+        spec[f"--{flag}"] = (field, True)
+        spec[f"--no-{flag}"] = (field, False)
+    out = {}
+    for arg in argv:
+        if arg in spec:
+            field, value = spec[arg]
+            out[field] = value
+    return out
+
+
+def _measure(per_chip_batch: int, timed: int = 24, image_size: int = 224,
+             model_overrides: dict | None = None):
     """Steady-state throughput of the full train step at the given
     per-chip batch. Returns (img/s/chip, flops-per-execution or 0)."""
     from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
@@ -140,7 +164,7 @@ def _measure(per_chip_batch: int, timed: int = 24, image_size: int = 224):
     cfg = TrainConfig(
         data=DataConfig(dataset="synthetic", batch_size=batch,
                         image_size=image_size),
-        model=ModelConfig(),              # bf16 compute
+        model=ModelConfig(**(model_overrides or {})),  # bf16 compute
         optim=OptimConfig(),
         mesh=MeshConfig(),
         checkpoint=CheckpointConfig(save_best=False, save_last=False),
@@ -229,12 +253,28 @@ def _measure(per_chip_batch: int, timed: int = 24, image_size: int = 224):
 
 def main() -> None:
     n_chips = jax.device_count()
+    overrides = _model_overrides(sys.argv[1:])
+    if overrides and "--enforce-budget" in sys.argv[1:]:
+        # The budget is the accepted measurement of the DEFAULT tree;
+        # gating a deliberately non-default lever state against it
+        # manufactures a false REGRESSION (e.g. --no-fused-ir measures
+        # the legacy path, which is over the ratcheted budget by
+        # design). Refuse loudly rather than letting the combination
+        # masquerade as a regression — same posture as bench_serve's
+        # --http --enforce-budget refusal.
+        _note("--enforce-budget gates the default configuration; "
+              f"refusing with lever overrides {overrides} (run the "
+              "gate without override flags, or compare A/B records "
+              "by hand per docs/performance.md)")
+        sys.exit(2)
     if "--smoke" in sys.argv[1:]:
         # Harness sanity check on small shapes (CPU-friendly); numbers
         # are meaningless, the JSON plumbing is what's exercised.
         (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
-         breakdown) = _measure(8, timed=3, image_size=32)
-        ref_ips = _measure(4, timed=3, image_size=32)[0]
+         breakdown) = _measure(8, timed=3, image_size=32,
+                               model_overrides=overrides)
+        ref_ips = _measure(4, timed=3, image_size=32,
+                           model_overrides=overrides)[0]
     elif "--peak-only" in sys.argv[1:]:
         # Flag/variant sweeps: just the peak-shape number (the batch-128
         # companion costs a second warmup and doesn't move with flags).
@@ -242,14 +282,14 @@ def main() -> None:
         # batch-512 figure would fabricate a measurement under a name
         # that promises the reference shape.
         (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
-         breakdown) = _measure(512)
+         breakdown) = _measure(512, model_overrides=overrides)
         ref_ips = None
     else:
         # Peak-throughput shape (per-chip batch sweep optimum) and the
         # reference's exact shape (cifar10_128batch.py:59: batch 128).
         (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
-         breakdown) = _measure(512)
-        ref_ips = _measure(128)[0]
+         breakdown) = _measure(512, model_overrides=overrides)
+        ref_ips = _measure(128, model_overrides=overrides)[0]
 
     peak = _peak_flops_per_chip()
     bw = _chip_spec(_HBM_BW)
@@ -299,6 +339,11 @@ def main() -> None:
         "bytes_per_image_breakdown": breakdown,
         "device_kind": jax.devices()[0].device_kind,
     }
+    if overrides:
+        # Variant runs are self-describing: a sweep artifact records
+        # which levers it measured (default runs omit the field, so
+        # the driver's BENCH_r* records keep their shape).
+        record["model_overrides"] = overrides
     print(json.dumps(record))
 
     if "--enforce-budget" in sys.argv[1:]:

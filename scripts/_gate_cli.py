@@ -1,0 +1,109 @@
+"""Shared CLI plumbing for the budget-gate scripts.
+
+check_bytes_budget.py and check_serve_budget.py present the same
+command line (flag-anywhere ``--budget PATH`` plus one record path or
+``-`` for stdin) and accept the same record containers (a plain JSON
+file, a piped bench stdout stream whose ``#``-note or warning lines
+precede the record — single-line or pretty-printed — or a driver-style
+artifact wrapping the record under ``"parsed"``). They also share the
+budget-entry lookup (``find_budget``). One module so a fix to either
+gate's plumbing cannot silently miss the other.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, Optional, Tuple, Union
+
+
+def find_budget(budgets: Optional[Dict], device_kind: Optional[str]
+                ) -> Tuple[Optional[str], Optional[Dict]]:
+    """Case-insensitive device-kind substring lookup -> (key, entry);
+    (None, None) when no budget entry matches this device."""
+    kind = (device_kind or "").lower()
+    for key, val in (budgets or {}).items():
+        if key.lower() in kind:
+            return key, val
+    return None, None
+
+
+def _parse_stream_record(raw: str) -> Dict:
+    """Parse a record out of a bench stdout stream.
+
+    A clean JSON document parses directly. Otherwise note/warning lines
+    may precede or follow the record, and the record itself may be
+    pretty-printed (bench_serve emits ``indent=1``, so inner lines also
+    start with ``{``): scan line-start braces in order, parse each
+    complete top-level document, and skip everything inside a parsed
+    document's span — an inner nested dict is never a candidate. The
+    last top-level document wins (a stream with several records gates
+    the latest one).
+    """
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        pass
+    dec = json.JSONDecoder()
+    last = None
+    consumed_to = 0
+    pos = 0
+    for ln in raw.splitlines(keepends=True):
+        stripped = ln.lstrip()
+        start = pos + (len(ln) - len(stripped))
+        pos += len(ln)
+        if start < consumed_to or not stripped.startswith("{"):
+            continue
+        try:
+            obj, end = dec.raw_decode(raw, start)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            last = obj
+            consumed_to = end
+    if last is None:
+        raise json.JSONDecodeError("no JSON record found in stream",
+                                   raw, 0)
+    return last
+
+
+def load_record_argv(argv, default_budget_path: str
+                     ) -> Union[int, Tuple[Dict, str]]:
+    """Parse the gate CLI and load its record.
+
+    Returns ``(record, budget_path)``, or an ``int`` exit code on a
+    usage error (message already printed to stderr).
+    """
+    budget_path = default_budget_path
+    rest = list(argv)
+    if "--budget" in rest:
+        i = rest.index("--budget")
+        if i + 1 >= len(rest):
+            print("--budget needs a path", file=sys.stderr)
+            return 2
+        budget_path = rest[i + 1]
+        del rest[i:i + 2]
+    # An unrecognized flag must be a loud usage error: silently treating
+    # its VALUE as the record path would gate the wrong file and exit 0
+    # — a false pass in CI.
+    unknown = [a for a in rest if a != "-" and a.startswith("-")]
+    if unknown:
+        print(f"unrecognized arguments: {' '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+    if not rest:
+        print("no record path given", file=sys.stderr)
+        return 2
+    if len(rest) > 1:
+        # Same loud posture: gating only rest[0] of a shell glob like
+        # BENCH_r*.json would let a regression in the others pass.
+        print(f"expected one record path, got: {' '.join(rest)}",
+              file=sys.stderr)
+        return 2
+    path = rest[0]
+    raw = sys.stdin.read() if path == "-" else open(path).read()
+    record = _parse_stream_record(raw)
+    # Driver-style bench artifacts wrap the record ({"parsed": {...}}).
+    if "parsed" in record and isinstance(record["parsed"], dict):
+        record = record["parsed"]
+    return record, budget_path

@@ -13,6 +13,15 @@ measured against (the ISSUE acceptance bar: >= 2x at concurrency 4).
     python scripts/bench_serve.py                 # synthetic weights
     python scripts/bench_serve.py --checkpoint-dir ckpt --vit-hidden 192
     python scripts/bench_serve.py --http http://HOST:PORT --prompt-len 64
+    python scripts/bench_serve.py --enforce-budget  # + absolute floor gate
+
+``--enforce-budget`` checks ``tokens_per_s_per_slot`` (peak engine
+tok/s over the offered-load sweep, divided by the KV slot count)
+against the checked-in floor in docs/serve_budget.json — the
+bytes-budget mechanism pointed at serving capacity (exit 3 on a
+drop past tolerance; scripts/check_serve_budget.py is the standalone
+form). The >=2x-vs-sequential RELATIVE test lives in tests/test_serve;
+the absolute floor catches both paths slowing down together.
 """
 
 from __future__ import annotations
@@ -168,10 +177,22 @@ def main() -> None:
                     help="comma-separated offered-load levels")
     ap.add_argument("--out", default="",
                     help="also write the result JSON here")
+    ap.add_argument("--enforce-budget", action="store_true",
+                    help="exit 3 when tokens_per_s_per_slot falls below "
+                         "the docs/serve_budget.json floor for this "
+                         "device kind")
     args = ap.parse_args()
     levels = [int(c) for c in args.concurrency.split(",") if c]
 
     if args.http:
+        if args.enforce_budget:
+            # The floor is keyed on device kind, which a remote HTTP
+            # record does not carry — refuse loudly rather than
+            # letting the flag silently no-op.
+            print("--enforce-budget is not supported with --http "
+                  "(no device kind in the record); run the in-process "
+                  "engine bench instead", file=sys.stderr)
+            sys.exit(2)
         results = [run_http_level(
             args.http.rstrip("/"), c, prompt_len=args.prompt_len,
             new_tokens=args.new_tokens,
@@ -244,11 +265,22 @@ def main() -> None:
             str(r["concurrency"]): round(r["tokens_per_s"] / seq_tps, 2)
             for r in results},
     }
+    from check_serve_budget import tokens_per_s_per_slot
+    tpss = tokens_per_s_per_slot(out)
+    if tpss is not None:
+        out["tokens_per_s_per_slot"] = round(tpss, 1)
     print(json.dumps(out, indent=1))
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
+    if args.enforce_budget:
+        from check_serve_budget import check_record, load_budget
+        ok, msgs = check_record(out, load_budget())
+        for m in msgs:
+            print(f"# {m}", file=sys.stderr, flush=True)
+        if not ok:
+            sys.exit(3)
 
 
 if __name__ == "__main__":

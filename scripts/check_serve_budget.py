@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Absolute serving-throughput floor for the continuous-batching engine.
+
+The bytes-budget mechanism (scripts/check_bytes_budget.py), pointed at
+serving: compares a ``scripts/bench_serve.py`` JSON record against the
+checked-in floor (docs/serve_budget.json) and exits nonzero when
+``tokens_per_s_per_slot`` — peak engine throughput divided by the KV
+slot count, the capacity number a replica is provisioned on — drops
+below ``budget * (1 - tolerance_pct/100)`` on this device kind.
+
+Usage:
+    python scripts/bench_serve.py | python scripts/check_serve_budget.py -
+    python scripts/check_serve_budget.py SERVE_BENCH.json
+    python scripts/bench_serve.py --enforce-budget   # same gate, in-process
+
+Semantics mirror the bytes budget, with the direction flipped
+(throughput is gated from BELOW):
+
+- ``budgets`` maps a device-kind substring (matched case-insensitively
+  against the record's ``device``) to the last ACCEPTED measurement of
+  ``tokens_per_s_per_slot``. A PR that speeds serving up should ratchet
+  the floor UP to the new measurement in the same change.
+- The gate FAILS when measured < budget * (1 - tolerance_pct/100).
+  Tolerance is deliberately wide (50%): wall-clock serving throughput
+  on a shared/contended host is far noisier than a compiler byte
+  count, and the sibling >=2x-vs-sequential RELATIVE regression test
+  (tests/test_serve.py) already catches engine-level slowdowns — this
+  absolute floor exists to catch the failure mode the relative test
+  cannot: both paths getting slower together.
+- A device kind with no budget entry passes with a note.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BUDGET = os.path.join(REPO, "docs", "serve_budget.json")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _gate_cli import find_budget, load_record_argv  # noqa: E402
+
+
+def load_budget(path: str = DEFAULT_BUDGET) -> Dict:
+    with open(path) as fp:
+        return json.load(fp)
+
+
+def tokens_per_s_per_slot(record: Dict):
+    """Peak tokens/s across offered-load levels, per KV slot. Computed
+    here (not only in bench_serve) so the gate also works on older
+    artifacts that predate the field.
+
+    A level with client errors still counts when tokens flowed: the
+    rate is real served traffic (a lower bound on capacity), and
+    dropping it would gate the lower levels' rate against the full
+    slot count — one flaky timeout at the peak level would read as a
+    false regression. Only a level that served NOTHING is excluded
+    (no measurement, and the broken-engine check in check_record
+    handles the all-dead case)."""
+    if record.get("tokens_per_s_per_slot") is not None:
+        return record["tokens_per_s_per_slot"]
+    slots = record.get("slots")
+    rates = []
+    for lv in record.get("levels") or []:
+        tps = lv.get("tokens_per_s")
+        if tps is None:
+            continue
+        if lv.get("errors") and not (tps > 0
+                                     or (lv.get("total_tokens") or 0) > 0):
+            continue                    # errored and served nothing
+        rates.append(tps)
+    if not slots or not rates:
+        return None
+    return max(rates) / slots
+
+
+def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
+    """-> (ok, messages). ok is False only on a real throughput drop;
+    a missing budget entry or an unmeasurable record passes with a
+    note (all-errors runs already fail loudly in bench_serve)."""
+    tol = float(budget.get("tolerance_pct", 50.0)) / 100.0
+    kind = record.get("device") or record.get("device_kind") or ""
+    key, entry = find_budget(budget.get("budgets"), kind)
+    if entry is None:
+        return True, [f"no serve budget for device kind {kind.lower()!r}; "
+                      "nothing to enforce"]
+    budgeted = entry.get("tokens_per_s_per_slot")
+    measured = tokens_per_s_per_slot(record)
+    if budgeted is None:
+        return True, [f"{key}: budget entry has no "
+                      "tokens_per_s_per_slot; nothing to enforce"]
+    if measured is None:
+        levels = record.get("levels") or []
+        total = sum(lv.get("total_tokens") or 0 for lv in levels)
+        if levels and total == 0 and all(lv.get("errors")
+                                         for lv in levels):
+            # A completely broken engine (every level errored AND zero
+            # tokens served) is the WORST regression the floor exists
+            # to catch — never let it pass as "no data". (Errored
+            # levels where tokens DID flow are real measurements and
+            # were already counted by tokens_per_s_per_slot.)
+            return False, [f"{key}: every offered-load level errored, "
+                           f"0 tokens served "
+                           f"({levels[0]['errors'][:1]}...); serving "
+                           "is broken [REGRESSION]"]
+        return True, [f"{key}: no usable tokens/s measurement in record "
+                      f"(floor {budgeted:.0f}); skipping"]
+    floor = budgeted * (1.0 - tol)
+    ok = measured >= floor
+    verdict = "OK" if ok else "REGRESSION"
+    return ok, [
+        f"{key}: tokens_per_s_per_slot measured {measured:.1f} vs "
+        f"floor {budgeted:.1f} (-{100 * tol:.0f}% tolerance -> "
+        f"limit {floor:.1f}) [{verdict}]"]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    loaded = load_record_argv(argv, DEFAULT_BUDGET)
+    if isinstance(loaded, int):
+        return loaded
+    record, budget_path = loaded
+    ok, msgs = check_record(record, load_budget(budget_path))
+    for m in msgs:
+        print(m)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,17 +165,107 @@ def test_budget_breakdown_category_gate():
     assert ok
 
 
-def test_checked_in_budget_file_is_valid():
+def _load_checked_in_budget():
     with open(os.path.join(REPO, "docs", "bytes_budget.json")) as fp:
-        budget = json.load(fp)
-    assert budget["budgets"]["TPU v5 lite"][
-        "xla_bytes_accessed_per_image"] > 0
-    # BENCH_r05's measurement must pass its own checked-in budget
-    # (the budget is the last accepted measurement, not a wish).
-    with open(os.path.join(REPO, "BENCH_r05.json")) as fp:
-        r05 = json.load(fp)["parsed"]
-    ok, msgs = check_record(r05, budget)
-    assert ok, msgs
+        return json.load(fp)
+
+
+def _bench_artifacts():
+    """[(round, parsed record)] for every BENCH_r*.json in the repo
+    root, oldest first. BENCH_rN measures the tree AFTER PR N-1."""
+    import glob
+    import re
+    out = []
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        with open(path) as fp:
+            data = json.load(fp)
+        rec = data.get("parsed") if isinstance(data, dict) else None
+        if isinstance(rec, dict):
+            out.append((int(m.group(1)), rec))
+    return sorted(out, key=lambda t: t[0])
+
+
+def test_checked_in_budget_file_is_valid():
+    """Structural validity: positive totals, real category names, and
+    category budgets that sum to no more than the total allows."""
+    budget = _load_checked_in_budget()
+    tol = budget["tolerance_pct"] / 100.0
+    assert tol > 0
+    for kind, entry in budget["budgets"].items():
+        total = entry["xla_bytes_accessed_per_image"]
+        assert total > 0, kind
+        bd = {k: v for k, v in (entry.get("breakdown") or {}).items()
+              if not k.startswith("_")}
+        assert set(bd) <= set(hlo_bytes.CATEGORIES), (kind, set(bd))
+        assert all(v > 0 for v in bd.values()), (kind, bd)
+        assert sum(bd.values()) <= total * (1 + tol), \
+            (kind, sum(bd.values()), total)
+
+
+def test_budget_vs_latest_bench_artifact():
+    """Budget/measurement drift fails tier-1 instead of waiting for a
+    slow bench run: every BENCH_r* artifact measuring this-or-newer
+    trees (round > the entry's as_of_round; BENCH_rN measures the
+    tree after PR N-1) must PASS the checked-in budget, and the budget
+    must not sit above the latest matching measurement (a stale or
+    wishful budget would mask regressions)."""
+    budget = _load_checked_in_budget()
+    tol = budget["tolerance_pct"] / 100.0
+    arts = _bench_artifacts()
+    assert arts, "no BENCH_r*.json artifacts found"
+    for kind, entry in budget["budgets"].items():
+        matching = [(rnd, rec) for rnd, rec in arts
+                    if kind.lower() in (rec.get("device_kind") or "").lower()]
+        if not matching:
+            continue
+        # Drift gate: artifacts measuring the budgeted tree (or newer).
+        for rnd, rec in matching:
+            if rnd > entry.get("as_of_round", 0):
+                ok, msgs = check_record(rec, budget)
+                assert ok, (f"BENCH_r{rnd:02d} fails the checked-in "
+                            f"budget — ratchet/reconcile "
+                            f"docs/bytes_budget.json", msgs)
+        # Staleness gate: the budget may anticipate a measured lever
+        # (ratchet + as_of_round bump) but never EXCEED the last
+        # measured reality by more than tolerance.
+        latest_total = matching[-1][1].get("xla_bytes_accessed_per_image")
+        if latest_total:
+            assert entry["xla_bytes_accessed_per_image"] <= \
+                latest_total * (1 + tol), \
+                (kind, entry["xla_bytes_accessed_per_image"], latest_total)
+
+
+def test_bench_model_overrides_last_flag_wins():
+    """Repeated lever flags resolve last-wins in argv order, matching
+    the train CLI's argparse BooleanOptionalAction — a sweep script
+    appending an override to a base command gets the appended state."""
+    import bench
+    assert bench._model_overrides(["--no-fused-ir", "--fused-ir"]) == \
+        {"fused_ir": True}
+    assert bench._model_overrides(["--fused-ir", "--no-fused-ir"]) == \
+        {"fused_ir": False}
+    assert bench._model_overrides(["--peak-only"]) == {}
+    assert bench._model_overrides(["--block-remat", "--no-fused-bn"]) == \
+        {"block_remat": True, "fused_bn": False}
+
+
+def test_bench_enforce_budget_refuses_lever_overrides(monkeypatch,
+                                                      capsys):
+    """--enforce-budget gates the default tree; combined with a lever
+    override it would gate a deliberately non-default configuration
+    against the default budget (false REGRESSION) — bench refuses
+    loudly with exit 2 instead."""
+    import bench
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--peak-only", "--no-fused-ir",
+                         "--enforce-budget"])
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert ei.value.code == 2
+    assert "refusing with lever overrides" in capsys.readouterr().err
 
 
 # ------------------------------------------------------------- end-to-end
@@ -220,13 +310,47 @@ ENTRY %main.1 (p0: f32[256]) -> f32[256] {
     assert coll == 2 * 256 * 4  # the -start's operand+output, ONCE
 
 
-def test_budget_cli_accepts_pretty_printed_artifact(capsys):
+def test_budget_cli_accepts_pretty_printed_artifact(tmp_path, capsys):
     """The documented `check_bytes_budget.py BENCH_r05.json` invocation
-    must parse the pretty-printed driver artifact, not crash."""
+    must parse the pretty-printed driver artifact, not crash. (Checked
+    against r05's own value, not the checked-in budget — the ratcheted
+    budget describes a NEWER tree than the r05 artifact measures.)"""
     from check_bytes_budget import main as budget_main
-    rc = budget_main([os.path.join(REPO, "BENCH_r05.json")])
+    b = tmp_path / "budget.json"
+    b.write_text(json.dumps(_budget(139e6)))
+    rc = budget_main([os.path.join(REPO, "BENCH_r05.json"),
+                      "--budget", str(b)])
     out = capsys.readouterr().out
     assert rc == 0 and "xla_bytes_accessed_per_image" in out
+
+
+def test_budget_cli_flag_order_and_missing_value(tmp_path, capsys):
+    """--budget may precede or follow the record path (mirroring
+    check_serve_budget); a trailing --budget with no value or a
+    missing record path is a usage error, not a crash."""
+    from check_bytes_budget import main as budget_main
+    b = tmp_path / "budget.json"
+    b.write_text(json.dumps(_budget(139e6)))
+    art = os.path.join(REPO, "BENCH_r05.json")
+    assert budget_main(["--budget", str(b), art]) == 0
+    assert budget_main([art, "--budget", str(b)]) == 0
+    assert budget_main([art, "--budget"]) == 2
+    assert budget_main(["--budget", str(b)]) == 2  # no record path
+
+
+def test_budget_breakdown_annotation_keys_and_missing_breakdown():
+    """'_'-prefixed breakdown keys are annotations (never gated), and
+    a record with no breakdown at all passes budgeted categories with
+    a note — the r05-style artifact predates the field."""
+    bud = _budget(100e6, breakdown={"_source": "estimate",
+                                    "conv_bwd": 45e6})
+    ok, msgs = check_record(_record(100e6, breakdown=None), bud)
+    assert ok and any("no bytes_per_image_breakdown" in m for m in msgs)
+    assert not any("_source" in m for m in msgs)
+    ok, _ = check_record(_record(100e6, breakdown={"conv_bwd": 44e6}), bud)
+    assert ok
+    ok, _ = check_record(_record(100e6, breakdown={"conv_bwd": 50e6}), bud)
+    assert not ok
 
 
 def test_augment_scope_gets_its_own_bucket():

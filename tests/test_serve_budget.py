@@ -1,0 +1,203 @@
+"""Absolute tokens/s/slot serving floor (scripts/check_serve_budget.py
++ docs/serve_budget.json + bench_serve.py --enforce-budget) — the
+bytes-budget mechanism pointed at serving capacity. The >=2x relative
+regression test lives in tests/test_serve_http.py; this floor catches
+the sequential baseline and the engine slowing down TOGETHER."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from check_serve_budget import (check_record, load_budget,  # noqa: E402
+                                tokens_per_s_per_slot)
+
+
+def _record(tpss=None, device="cpu", slots=8, levels=None):
+    rec = {"device": device, "slots": slots, "levels": levels or []}
+    if tpss is not None:
+        rec["tokens_per_s_per_slot"] = tpss
+    return rec
+
+
+def _budget(floor, tol=50):
+    return {"tolerance_pct": tol,
+            "budgets": {"cpu": {"tokens_per_s_per_slot": floor}}}
+
+
+def test_throughput_above_floor_passes():
+    ok, msgs = check_record(_record(tpss=80.0), _budget(100.0))
+    assert ok and any("OK" in m for m in msgs)
+
+
+def test_throughput_below_floor_fails():
+    ok, msgs = check_record(_record(tpss=49.0), _budget(100.0))
+    assert not ok and any("REGRESSION" in m for m in msgs)
+
+
+def test_unknown_device_passes_with_note():
+    ok, msgs = check_record(_record(tpss=1.0, device="TPU v5 lite"),
+                            _budget(100.0))
+    assert ok and any("no serve budget" in m for m in msgs)
+
+
+def test_missing_measurement_skips_with_note():
+    ok, msgs = check_record(_record(), _budget(100.0))
+    assert ok and any("skipping" in m for m in msgs)
+
+
+def test_tokens_per_s_per_slot_derived_from_levels():
+    """Older artifacts without the field still gate: peak level over
+    slots. An errored level still counts when tokens flowed (the rate
+    is a lower bound on capacity); a level that served nothing is no
+    measurement."""
+    rec = _record(slots=4, levels=[
+        {"concurrency": 1, "tokens_per_s": 100.0, "errors": []},
+        {"concurrency": 4, "tokens_per_s": 400.0, "errors": []},
+        {"concurrency": 8, "tokens_per_s": 900.0, "errors": ["boom"]}])
+    assert tokens_per_s_per_slot(rec) == 225.0
+    rec["levels"][2]["tokens_per_s"] = 0.0      # errored, served nothing
+    assert tokens_per_s_per_slot(rec) == 100.0
+    rec["tokens_per_s_per_slot"] = 55.5  # explicit field wins
+    assert tokens_per_s_per_slot(rec) == 55.5
+
+
+def test_all_levels_errored_fails_the_gate():
+    """A completely broken engine (every level errored -> no usable
+    rate) must FAIL, not pass as 'no data' — it is the worst
+    regression the floor exists to catch."""
+    rec = _record(slots=8, levels=[
+        {"concurrency": 1, "tokens_per_s": 0.0, "total_tokens": 0,
+         "errors": ["Timeout"]},
+        {"concurrency": 4, "tokens_per_s": 0.0, "total_tokens": 0,
+         "errors": ["Timeout"]}])
+    ok, msgs = check_record(rec, _budget(100.0))
+    assert not ok and any("REGRESSION" in m for m in msgs)
+
+
+def test_flaky_errors_with_tokens_flowing_is_not_broken():
+    """One flaky client error per level while tokens still flow is NOT
+    'serving is broken' — the served rates are real measurements and
+    gate normally (the contended CI host produces exactly this
+    shape)."""
+    rec = _record(slots=8, levels=[
+        {"concurrency": 1, "tokens_per_s": 500.0, "total_tokens": 960,
+         "errors": ["client 0: Timeout"]},
+        {"concurrency": 4, "tokens_per_s": 900.0, "total_tokens": 1800,
+         "errors": ["client 2: Timeout"]}])
+    assert tokens_per_s_per_slot(rec) == 112.5
+    ok, msgs = check_record(rec, _budget(100.0))
+    assert ok and any("OK" in m for m in msgs)
+
+
+def test_error_at_peak_level_does_not_bias_the_floor():
+    """A flaky error at the highest offered load must not drop that
+    level's rate from the measurement: the lower level's rate over the
+    FULL slot count would read as a false regression on a healthy
+    engine."""
+    rec = _record(slots=8, levels=[
+        {"concurrency": 4, "tokens_per_s": 500.0, "total_tokens": 960,
+         "errors": []},
+        {"concurrency": 8, "tokens_per_s": 900.0, "total_tokens": 1800,
+         "errors": ["client 2: Timeout"]}])
+    assert tokens_per_s_per_slot(rec) == 112.5
+    ok, msgs = check_record(rec, _budget(200.0))     # limit = 100.0
+    assert ok, msgs    # 500/8 = 62.5 alone would have failed
+
+
+def test_checked_in_serve_budget_file_is_valid():
+    budget = load_budget()
+    assert budget["tolerance_pct"] > 0
+    cpu = budget["budgets"]["cpu"]
+    assert cpu["tokens_per_s_per_slot"] > 0
+    # The floor must be enforceable against a record shaped like
+    # bench_serve's output.
+    ok, msgs = check_record(
+        _record(tpss=cpu["tokens_per_s_per_slot"]), budget)
+    assert ok, msgs
+
+
+def test_budget_cli_parses_artifact(tmp_path, capsys):
+    from check_serve_budget import main as serve_budget_main
+    art = tmp_path / "serve.json"
+    art.write_text(json.dumps(_record(tpss=1e9)))
+    assert serve_budget_main([str(art)]) == 0
+    art.write_text(json.dumps(_record(tpss=0.001)))
+    assert serve_budget_main([str(art)]) == 1
+
+
+def test_budget_cli_flag_order_and_missing_value(tmp_path, capsys):
+    """--budget may precede or follow the record path; a trailing
+    --budget with no value is a usage error, not a crash."""
+    from check_serve_budget import main as serve_budget_main
+    art = tmp_path / "serve.json"
+    art.write_text(json.dumps(_record(tpss=1e9)))
+    bud = tmp_path / "budget.json"
+    bud.write_text(json.dumps(_budget(100.0)))
+    assert serve_budget_main(["--budget", str(bud), str(art)]) == 0
+    assert serve_budget_main([str(art), "--budget", str(bud)]) == 0
+    assert serve_budget_main([str(art), "--budget"]) == 2
+    assert serve_budget_main(["--budget", str(bud)]) == 2  # no record
+
+
+def test_budget_cli_rejects_unknown_flags(tmp_path, capsys):
+    """A typo'd flag must be a loud usage error (exit 2): silently
+    treating its value as the record path would gate the wrong file
+    and exit 0 — a false pass in CI."""
+    from check_serve_budget import main as serve_budget_main
+    bud = tmp_path / "budget.json"
+    bud.write_text(json.dumps(_budget(100.0)))
+    art = tmp_path / "serve.json"
+    art.write_text(json.dumps(_record(tpss=0.001)))   # would gate FAIL
+    assert serve_budget_main(["--bugdet", str(bud), str(art)]) == 2
+    # Same posture for extra positionals (a shell glob would gate only
+    # the first file and let a regression in the others pass).
+    art2 = tmp_path / "serve2.json"
+    art2.write_text(json.dumps(_record(tpss=1e9)))
+    assert serve_budget_main([str(art2), str(art)]) == 2
+
+
+def test_budget_cli_parses_piped_pretty_stream(tmp_path, capsys,
+                                               monkeypatch):
+    """`bench_serve | check_serve_budget.py -`: bench_serve emits
+    indent=1 pretty JSON, and a note/warning line may precede it — the
+    stream fallback must find the record, not an inner nested brace."""
+    import io
+    from check_serve_budget import main as serve_budget_main
+    raw = ("# warming up\n" +
+           json.dumps(_record(tpss=1e9, levels=[
+               {"concurrency": 1, "tokens_per_s": 8e9, "errors": []}]),
+               indent=1) + "\n")
+    monkeypatch.setattr("sys.stdin", io.StringIO(raw))
+    assert serve_budget_main(["-"]) == 0
+    # Trailing non-JSON output after the record (2>&1 pipes interleave
+    # the gate's own verdict lines) must not make an inner nested dict
+    # win: a REGRESSING record must still fail, not skip with
+    # 'no serve budget'.
+    raw = ("# warming up\n" +
+           json.dumps(_record(tpss=0.001, levels=[
+               {"concurrency": 1, "tokens_per_s": 5.0, "errors": []}]),
+               indent=1) + "\ndone\n")
+    monkeypatch.setattr("sys.stdin", io.StringIO(raw))
+    assert serve_budget_main(["-"]) == 1
+
+
+@pytest.mark.slow
+def test_bench_serve_enforce_budget_end_to_end():
+    """bench_serve.py --enforce-budget on this host: record carries
+    tokens_per_s_per_slot and the gate passes against the checked-in
+    floor (a >50% drop on an idle host is a real regression)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serve.py"),
+         "--enforce-budget"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=800)
+    assert out.returncode == 0, (out.returncode, out.stderr[-2000:])
+    rec = json.loads(out.stdout)
+    assert rec["tokens_per_s_per_slot"] > 0
+    assert "tokens_per_s_per_slot" in out.stderr  # the gate's verdict line

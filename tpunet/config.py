@@ -189,6 +189,20 @@ class ModelConfig:
     # Measured -8.4% xla_bytes_accessed/image on the CPU-compiled
     # 224px step; same variable tree, so flippable on checkpoints.
     fused_bn: bool = True
+    # fused_ir (default ON): route the inverted-residual expand /
+    # project 1x1 convs through the fused Pallas kernel pair
+    # (tpunet/ops/fused_ir.py): one-pass conv + BN-stats forward (the
+    # training-BN statistics read of the conv output never hits HBM)
+    # and an IO-aware backward that recomputes the elementwise
+    # epilogue in VMEM. TPU-only and per-shape (the kernel engages
+    # only where its dw-partial cost is below the saved reads — see
+    # use_fused_ir_kernel); elsewhere the ops are numerically the
+    # fused_bn path, eval mode is always the plain path (bit-identical
+    # logits across the flag), and the variable tree is unchanged, so
+    # it flips freely on checkpoints (--no-fused-ir, or
+    # TPUNET_FUSED_IR_REF=1 without re-lowering configs). Requires
+    # fused_bn (the fused epilogue math is what the kernel computes).
+    fused_ir: bool = True
     # block_remat (default OFF): saved-residual policy for the
     # inverted-residual blocks — keep only conv outputs + (C,)-sized
     # BN stats as residuals and recompute the elementwise epilogues in
@@ -689,6 +703,14 @@ def build_argparser() -> argparse.ArgumentParser:
                         "fusable region (default on; --no-fused-bn "
                         "restores the nn.BatchNorm + separate clamp "
                         "path, same parameters)")
+    p.add_argument("--fused-ir", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="MobileNetV2: fused 1x1-conv + BN-stats Pallas "
+                        "kernel pair for the inverted-residual expand/"
+                        "project convs (default on; TPU-only and "
+                        "per-shape — elsewhere numerically identical "
+                        "to --fused-bn; --no-fused-ir restores the "
+                        "XLA path, same parameters)")
     p.add_argument("--block-remat", default=None,
                    action=argparse.BooleanOptionalAction,
                    help="MobileNetV2: recompute inverted-residual "
@@ -808,6 +830,8 @@ def config_from_args(argv=None) -> TrainConfig:
                                     use_pallas_depthwise=args.pallas_depthwise)
     if args.fused_bn is not None:
         model = dataclasses.replace(model, fused_bn=args.fused_bn)
+    if args.fused_ir is not None:
+        model = dataclasses.replace(model, fused_ir=args.fused_ir)
     if args.block_remat is not None:
         model = dataclasses.replace(model, block_remat=args.block_remat)
     if args.dtype is not None:

@@ -48,6 +48,13 @@ INVERTED_RESIDUAL_SETTINGS: Tuple[Tuple[int, int, int, int], ...] = (
 conv_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 dense_init = nn.initializers.normal(stddev=0.01)
 
+# BatchNorm hyperparameters (torch momentum 0.1 == flax decay 0.9) —
+# single source of truth for every BN path (nn.BatchNorm, FusedBNAct,
+# _FusedIRBN): the fused paths promise checkpoint/numerics parity with
+# the plain path, which a per-call-site literal drifting would break.
+BN_MOMENTUM = 0.9
+BN_EPSILON = 1e-5
+
 
 def _make_divisible(v: float, divisor: int = 8) -> int:
     """Round channel counts like torchvision does for width multipliers."""
@@ -104,8 +111,8 @@ class FusedBNAct(nn.Module):
     """
 
     act: bool = True
-    momentum: float = 0.9
-    epsilon: float = 1e-5
+    momentum: float = BN_MOMENTUM
+    epsilon: float = BN_EPSILON
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -148,12 +155,76 @@ class FusedBNAct(nn.Module):
         return y
 
 
+class _Conv1x1Kernel(nn.Module):
+    """Parameter holder for the fused-IR 1x1 conv path: the 'kernel'
+    param ((1, 1, Ci, Co), same name/shape/init as ``nn.Conv`` with
+    use_bias=False) lives under the same 'conv' module path, so
+    checkpoints and converted torch weights are interchangeable with
+    the unfused path."""
+
+    features: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        return self.param("kernel", conv_init,
+                          (1, 1, in_features, self.features),
+                          self.param_dtype)
+
+
+class _FusedIRBN(nn.Module):
+    """BN affine params + running stats for the fused-IR path, living
+    under the same 'bn' module path (scale/bias params, f32 mean/var
+    batch_stats) as ``FusedBNAct``/``nn.BatchNorm`` — identical
+    variable tree, flippable on existing checkpoints. The conv + batch
+    stats + normalize/clamp all run inside
+    ``tpunet.ops.fused_ir.conv1x1_bn_act`` (one-pass Pallas kernel on
+    TPU where the shape pays, the exact FusedBNAct math elsewhere);
+    this module contributes the parameters and consumes the returned
+    batch stats for the running-average update."""
+
+    act: bool = True
+    momentum: float = BN_MOMENTUM
+    epsilon: float = BN_EPSILON
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, kernel):
+        c = kernel.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,),
+                           self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (c,),
+                          self.param_dtype)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32), (c,))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32), (c,))
+        from tpunet.ops import fused_ir
+        y, mean, var = fused_ir.conv1x1_bn_act(
+            x.astype(self.dtype), kernel[0, 0].astype(self.dtype),
+            scale, bias, act=self.act, eps=self.epsilon)
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1 - m) * mean
+            ra_var.value = m * ra_var.value + (1 - m) * var
+        y = y.astype(self.dtype)
+        assert y.dtype == jnp.dtype(self.dtype)  # bf16 residency
+        return y
+
+
 class ConvBN(nn.Module):
     """Conv + BatchNorm (+ optional ReLU6), the MobileNetV2 building unit.
 
     ``fused_bn`` (default) expresses BN + clamp through ``FusedBNAct``
     — one fusable epilogue region; off, the original ``nn.BatchNorm``
     + separate ReLU6 path (bit-compatible variable trees either way).
+    ``fused_ir`` (default, train-mode 1x1 convs only) additionally
+    routes conv + batch stats through the one-pass fused-IR kernel
+    (tpunet/ops/fused_ir.py): the training-BN statistics read of the
+    conv output never hits HBM, and the backward recomputes the
+    epilogue in VMEM. Eval mode always takes the plain path, so eval
+    logits are bit-identical across the flag.
     """
 
     features: int
@@ -163,11 +234,23 @@ class ConvBN(nn.Module):
     act: bool = True
     use_pallas: bool = False
     fused_bn: bool = True
+    fused_ir: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if (self.fused_ir and self.fused_bn and train
+                and self.kernel == 1 and self.stride == 1
+                and self.groups == 1):
+            kernel = _Conv1x1Kernel(self.features,
+                                    param_dtype=self.param_dtype,
+                                    name="conv")(x.shape[-1])
+            return _FusedIRBN(act=self.act, momentum=BN_MOMENTUM,
+                              epsilon=BN_EPSILON,
+                              dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              name="bn")(x, kernel)
         pad = (self.kernel - 1) // 2
         if (self.use_pallas and self.kernel == 3 and self.groups > 1
                 and self.groups == self.features == x.shape[-1]):
@@ -194,14 +277,15 @@ class ConvBN(nn.Module):
         from jax.ad_checkpoint import checkpoint_name
         x = checkpoint_name(x, "tpunet_convout")
         if self.fused_bn:
-            return FusedBNAct(act=self.act, momentum=0.9, epsilon=1e-5,
+            return FusedBNAct(act=self.act, momentum=BN_MOMENTUM,
+                              epsilon=BN_EPSILON,
                               dtype=self.dtype,
                               param_dtype=self.param_dtype,
                               name="bn")(x, train)
         x = nn.BatchNorm(
             use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPSILON,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="bn",
@@ -219,6 +303,7 @@ class InvertedResidual(nn.Module):
     expand_ratio: int
     use_pallas: bool = False
     fused_bn: bool = True
+    fused_ir: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -229,14 +314,15 @@ class InvertedResidual(nn.Module):
         y = x
         if self.expand_ratio != 1:
             y = ConvBN(hidden, kernel=1, fused_bn=self.fused_bn,
-                       dtype=self.dtype,
+                       fused_ir=self.fused_ir, dtype=self.dtype,
                        param_dtype=self.param_dtype, name="expand")(y, train)
         y = ConvBN(hidden, kernel=3, stride=self.stride, groups=hidden,
                    use_pallas=self.use_pallas, fused_bn=self.fused_bn,
                    dtype=self.dtype, param_dtype=self.param_dtype,
                    name="depthwise")(y, train)
         y = ConvBN(self.features, kernel=1, act=False,
-                   fused_bn=self.fused_bn, dtype=self.dtype,
+                   fused_bn=self.fused_bn, fused_ir=self.fused_ir,
+                   dtype=self.dtype,
                    param_dtype=self.param_dtype, name="project")(y, train)
         if self.stride == 1 and in_features == self.features:
             y = y + x
@@ -256,6 +342,7 @@ class MobileNetV2(nn.Module):
     dropout_rate: float = 0.2
     use_pallas: bool = False
     fused_bn: bool = True
+    fused_ir: bool = False
     block_remat: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -291,6 +378,7 @@ class MobileNetV2(nn.Module):
                 x = Block(
                     out_ch, stride=s if i == 0 else 1, expand_ratio=t,
                     use_pallas=self.use_pallas, fused_bn=self.fused_bn,
+                    fused_ir=self.fused_ir,
                     dtype=self.dtype, param_dtype=self.param_dtype,
                     name=f"block{idx:02d}")(x, train)
                 idx += 1
@@ -309,12 +397,21 @@ class MobileNetV2(nn.Module):
 def create_model(cfg: ModelConfig) -> MobileNetV2:
     if cfg.name != "mobilenet_v2":
         raise ValueError(f"unknown model {cfg.name!r}")
+    if cfg.fused_ir and not cfg.fused_bn:
+        # The fused-IR kernel computes the FusedBNAct epilogue math, so
+        # it only engages on the fused_bn path — warn loudly rather
+        # than let an A/B record claim a lever that never ran.
+        import warnings
+        warnings.warn("fused_ir=True has no effect with fused_bn=False "
+                      "(the fused kernel computes the fused-BN epilogue); "
+                      "running the plain path", stacklevel=2)
     return MobileNetV2(
         num_classes=cfg.num_classes,
         width_mult=cfg.width_mult,
         dropout_rate=cfg.dropout_rate,
         use_pallas=cfg.use_pallas_depthwise,
         fused_bn=cfg.fused_bn,
+        fused_ir=cfg.fused_ir,
         block_remat=cfg.block_remat,
         dtype=jnp.dtype(cfg.dtype),
         param_dtype=jnp.dtype(cfg.param_dtype),

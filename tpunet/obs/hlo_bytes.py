@@ -105,8 +105,13 @@ def _shape_bytes(type_str: str) -> int:
 
 def is_backward(op_name: str) -> bool:
     """True when the framework op name sits under an autodiff
-    transpose scope (cotangent computation, remat replays included)."""
-    return "transpose(" in (op_name or "")
+    transpose scope (cotangent computation, remat replays included) or
+    an explicit backward marker (the fused-IR / depthwise custom_vjp
+    backwards, whose ops a custom-vjp rule does not nest under
+    ``transpose(``)."""
+    name = op_name or ""
+    return ("transpose(" in name or "tpunet_fused_ir_bwd" in name
+            or "tpunet_depthwise_bwd" in name)
 
 
 def phase_of(op_name: str) -> str:
@@ -142,6 +147,14 @@ def categorize(opcode: str, op_name: str) -> str:
         # Before the conv/dot checks: the rotation's shear matmul
         # banks are dots, but they are input-pipeline work.
         return "augment"
+    if "tpunet_fused_ir" in name or "tpunet_depthwise" in name:
+        # The fused inverted-residual and depthwise Pallas kernels
+        # lower to custom calls, not convolution opcodes; their
+        # explicit fwd/bwd scopes keep them in the conv buckets the
+        # budget gates. (The tpunet_ prefix keeps the match off the
+        # model's '/depthwise/' module path, whose XLA convs the
+        # opcode branch below already handles.)
+        return "conv_bwd" if is_backward(name) else "conv_fwd"
     leaf = _leaf_primitive(name)
     if opcode == "convolution" or "conv_general_dilated" in leaf:
         return "conv_bwd" if is_backward(name) else "conv_fwd"

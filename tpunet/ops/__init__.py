@@ -14,6 +14,10 @@ Two families live here:
   long-context support in the attention-based model families.
 - ``flash``: Pallas TPU flash-attention kernel — the fused MXU form of
   the same online-softmax math (scores never leave VMEM).
+- ``fused_ir``: Pallas kernel pair for the inverted-residual 1x1 convs
+  (expand/project): one-pass conv + BN-stats forward and an IO-aware
+  backward that recomputes the elementwise epilogue in VMEM — the
+  HBM-traffic lever behind ``ModelConfig.fused_ir``.
 """
 
 from tpunet.ops.attention import (blockwise_attention, dense_attention,
@@ -21,9 +25,12 @@ from tpunet.ops.attention import (blockwise_attention, dense_attention,
                                   ulysses_attention, ulysses_self_attention)
 from tpunet.ops.depthwise import depthwise_conv3x3, depthwise_conv3x3_reference
 from tpunet.ops.flash import flash_attention
+from tpunet.ops.fused_ir import conv1x1_bn_act, conv1x1_bn_act_reference
 
 __all__ = [
     "blockwise_attention",
+    "conv1x1_bn_act",
+    "conv1x1_bn_act_reference",
     "dense_attention",
     "depthwise_conv3x3",
     "depthwise_conv3x3_reference",

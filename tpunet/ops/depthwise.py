@@ -204,7 +204,11 @@ def depthwise_conv3x3(x: jax.Array, w: jax.Array, stride: int = 1,
         if jax.default_backend() != "tpu":
             return depthwise_conv3x3_reference(x, w, stride)
         interpret = False
-    return _partitioned(x, w, stride, interpret)
+    # Named for byte/phase attribution (tpunet/obs/hlo_bytes.py): the
+    # kernel lowers to a custom call, not a convolution opcode, so the
+    # scope is what keeps it in the conv_fwd bucket.
+    with jax.named_scope("tpunet_depthwise_fwd"):
+        return _partitioned(x, w, stride, interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -391,21 +395,29 @@ def _fwd(x, w, stride, interpret):
 
 
 def _bwd(stride, interpret, res, g):
-    x, w = res
-    # Mirror the primal's dispatch: interpret=None means "Pallas on
-    # TPU, XLA reference elsewhere" (the interpreter is too slow for a
-    # hot path); interpret=True exercises the kernels in tests.
-    # TPUNET_DEPTHWISE_REF_BWD=1 is the escape hatch back to the
-    # reference-transpose backward (e.g. a Mosaic regression on a new
-    # toolchain) without giving up the Pallas forward.
-    if interpret is None:
-        if jax.default_backend() != "tpu":
+    # The whole body sits under the tpunet_depthwise_bwd scope: a
+    # custom_vjp backward carries no ``transpose(`` marker, so the
+    # scope is what keeps the kernel's custom call (and the reference
+    # fallback's transposed conv, and the dw batch-sum) attributed to
+    # the backward phase / conv_bwd bucket (tpunet/obs/hlo_bytes.py)
+    # instead of leaking into fwd — the same contract as the fused-IR
+    # pair's backward.
+    with jax.named_scope("tpunet_depthwise_bwd"):
+        x, w = res
+        # Mirror the primal's dispatch: interpret=None means "Pallas on
+        # TPU, XLA reference elsewhere" (the interpreter is too slow
+        # for a hot path); interpret=True exercises the kernels in
+        # tests. TPUNET_DEPTHWISE_REF_BWD=1 is the escape hatch back to
+        # the reference-transpose backward (e.g. a Mosaic regression on
+        # a new toolchain) without giving up the Pallas forward.
+        if interpret is None:
+            if jax.default_backend() != "tpu":
+                return _reference_bwd(x, w, g, stride)
+            interpret = False
+        if os.environ.get("TPUNET_DEPTHWISE_REF_BWD"):
             return _reference_bwd(x, w, g, stride)
-        interpret = False
-    if os.environ.get("TPUNET_DEPTHWISE_REF_BWD"):
-        return _reference_bwd(x, w, g, stride)
-    dx, dwp = _partitioned_bwd(x, w, g, stride, interpret)
-    return dx, jnp.sum(dwp, axis=0).astype(w.dtype)
+        dx, dwp = _partitioned_bwd(x, w, g, stride, interpret)
+        return dx, jnp.sum(dwp, axis=0).astype(w.dtype)
 
 
 depthwise_conv3x3.defvjp(_fwd, _bwd)
