@@ -14,16 +14,168 @@
 // (tpunet/data/native.py) with a pure-numpy fallback when the toolchain
 // is unavailable.
 
+#include <fcntl.h>
+#include <signal.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <functional>
 #include <mutex>
 #include <thread>
 #include <vector>
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// Operation journal: the native half of the flight recorder
+// (tpunet/obs/flightrec/). A small fixed ring of the last N
+// alloc/free/enqueue/shutdown operations, recorded lock-free (one
+// relaxed fetch_add per op) from every thread that touches the
+// batcher. Two readers: tn_journal_read (live snapshot, Python side)
+// and the crash handler below, which spills the ring to a text file
+// with async-signal-safe primitives only (open/write/close + manual
+// integer formatting) before chaining to the previously installed
+// handler (faulthandler's, when Python armed the recorder). This is
+// the instrument aimed at the glibc heap-corruption-on-resume bug:
+// when malloc aborts, the journal says what the batcher had just
+// allocated, freed, or torn down.
+
+// Mirrored in tpunet/obs/flightrec/report.py NATIVE_OPS; bump together.
+enum JournalOp : uint32_t {
+  kJopCreate = 1,
+  kJopDestroy = 2,
+  kJopEpochStart = 3,
+  kJopEpochReject = 4,
+  kJopNextPop = 5,
+  kJopNextEof = 6,
+  kJopBatchAlloc = 7,
+  kJopBatchPush = 8,
+  kJopWorkerEnter = 9,
+  kJopWorkerExit = 10,
+  kJopStopBegin = 11,
+  kJopStopJoined = 12,
+  kJopGather = 13,
+};
+
+struct JournalEntry {
+  uint64_t seq;
+  uint32_t op;
+  uint32_t tid;
+  int64_t a;
+  int64_t b;
+};
+
+constexpr uint64_t kJournalSlots = 256;
+JournalEntry g_journal[kJournalSlots];
+std::atomic<uint64_t> g_journal_seq{0};
+
+uint32_t journal_tid() {
+  static thread_local uint32_t tid = static_cast<uint32_t>(
+      std::hash<std::thread::id>()(std::this_thread::get_id()));
+  return tid;
+}
+
+void journal(JournalOp op, int64_t a = 0, int64_t b = 0) {
+  const uint64_t seq =
+      g_journal_seq.fetch_add(1, std::memory_order_relaxed) + 1;
+  JournalEntry& e = g_journal[(seq - 1) % kJournalSlots];
+  // Racy by design (a reader may see a torn slot during the write);
+  // seq is stored last so readers can drop slots whose seq doesn't
+  // match the position they expected.
+  e.seq = 0;
+  e.op = op;
+  e.tid = journal_tid();
+  e.a = a;
+  e.b = b;
+  e.seq = seq;
+}
+
+int journal_snapshot(JournalEntry* out, int max_entries) {
+  const uint64_t cur = g_journal_seq.load(std::memory_order_relaxed);
+  const uint64_t span = cur < kJournalSlots ? cur : kJournalSlots;
+  int n = 0;
+  for (uint64_t s = cur - span + 1; s <= cur && n < max_entries; ++s) {
+    const JournalEntry e = g_journal[(s - 1) % kJournalSlots];
+    if (e.seq != s) continue;  // torn or already lapped
+    out[n++] = e;
+  }
+  return n;
+}
+
+// -- crash handler (async-signal-safe only below this line) -----------------
+
+char g_crash_path[1024] = {0};
+struct sigaction g_old_sa[3];
+const int g_crash_sigs[3] = {SIGSEGV, SIGABRT, SIGBUS};
+
+void write_str(int fd, const char* s) {
+  size_t n = 0;
+  while (s[n]) ++n;
+  ssize_t r = write(fd, s, n);
+  (void)r;
+}
+
+void write_dec(int fd, long long v) {
+  char buf[24];
+  int i = sizeof(buf);
+  bool neg = v < 0;
+  unsigned long long u =
+      neg ? ~static_cast<unsigned long long>(v) + 1ull : v;
+  do {
+    buf[--i] = '0' + static_cast<char>(u % 10);
+    u /= 10;
+  } while (u && i > 1);
+  if (neg) buf[--i] = '-';
+  ssize_t r = write(fd, buf + i, sizeof(buf) - i);
+  (void)r;
+}
+
+void crash_handler(int sig, siginfo_t*, void*) {
+  if (g_crash_path[0]) {
+    const int fd =
+        open(g_crash_path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd >= 0) {
+      write_str(fd, "tn-crash sig=");
+      write_dec(fd, sig);
+      write_str(fd, " seq=");
+      write_dec(fd, static_cast<long long>(
+          g_journal_seq.load(std::memory_order_relaxed)));
+      write_str(fd, "\n");
+      // Static snapshot buffer: no malloc in a handler that may be
+      // here BECAUSE malloc's heap is corrupted.
+      static JournalEntry snap[kJournalSlots];
+      const int n = journal_snapshot(snap, kJournalSlots);
+      for (int i = 0; i < n; ++i) {
+        write_str(fd, "j ");
+        write_dec(fd, static_cast<long long>(snap[i].seq));
+        write_str(fd, " ");
+        write_dec(fd, snap[i].op);
+        write_str(fd, " ");
+        write_dec(fd, snap[i].tid);
+        write_str(fd, " ");
+        write_dec(fd, snap[i].a);
+        write_str(fd, " ");
+        write_dec(fd, snap[i].b);
+        write_str(fd, "\n");
+      }
+      close(fd);
+    }
+  }
+  // Chain: restore whoever was installed before us (faulthandler,
+  // which dumps Python stacks and re-raises the default) and
+  // re-deliver.
+  for (int i = 0; i < 3; ++i) {
+    if (g_crash_sigs[i] == sig) {
+      sigaction(sig, &g_old_sa[i], nullptr);
+      break;
+    }
+  }
+  raise(sig);
+}
 
 void gather_range(const uint8_t* src, const int64_t* idx, int64_t begin,
                   int64_t end, int64_t row_bytes, uint8_t* out) {
@@ -35,6 +187,7 @@ void gather_range(const uint8_t* src, const int64_t* idx, int64_t begin,
 
 void gather_rows_impl(const uint8_t* src, const int64_t* idx, int64_t n_idx,
                       int64_t row_bytes, uint8_t* out, int n_threads) {
+  journal(kJopGather, n_idx, row_bytes);
   if (n_threads <= 1 || n_idx < 2 * n_threads) {
     gather_range(src, idx, 0, n_idx, row_bytes, out);
     return;
@@ -69,21 +222,30 @@ class Prefetcher {
         row_bytes_(row_bytes),
         local_batch_(local_batch),
         depth_(depth < 1 ? 1 : depth),
-        n_threads_(n_threads < 1 ? 1 : n_threads) {}
+        n_threads_(n_threads < 1 ? 1 : n_threads) {
+    journal(kJopCreate, local_batch, depth_);
+  }
 
-  ~Prefetcher() { stop(); }
+  ~Prefetcher() {
+    stop();
+    journal(kJopDestroy, consumed_, n_batches_);
+  }
 
   // Returns 0 on success, -1 if any index is out of range (the epoch is
   // then not started — failing cleanly instead of a wild memcpy).
   int start_epoch(const int64_t* idx, int64_t n_idx) {
     for (int64_t i = 0; i < n_idx; ++i) {
-      if (idx[i] < 0 || idx[i] >= n_rows_) return -1;
+      if (idx[i] < 0 || idx[i] >= n_rows_) {
+        journal(kJopEpochReject, n_idx, idx[i]);
+        return -1;
+      }
     }
     stop();
     idx_.assign(idx, idx + n_idx);
     n_batches_ = n_idx / local_batch_;  // drop remainder, like the pipeline
     consumed_ = 0;
     stopping_ = false;
+    journal(kJopEpochStart, n_idx, n_batches_);
     worker_ = std::thread(&Prefetcher::run, this);
     return 0;
   }
@@ -91,13 +253,17 @@ class Prefetcher {
   // 0 = batch copied out; 1 = epoch exhausted.
   int next(uint8_t* out_images, int32_t* out_labels) {
     std::unique_lock<std::mutex> lk(mu_);
-    if (consumed_ >= n_batches_) return 1;
+    if (consumed_ >= n_batches_) {
+      journal(kJopNextEof, consumed_, n_batches_);
+      return 1;
+    }
     ready_cv_.wait(lk, [&] { return !ring_.empty(); });
     Batch b = std::move(ring_.front());
     ring_.pop_front();
     ++consumed_;
     lk.unlock();
     space_cv_.notify_one();
+    journal(kJopNextPop, consumed_, static_cast<int64_t>(b.images.size()));
     std::memcpy(out_images, b.images.data(), b.images.size());
     std::memcpy(out_labels, b.labels.data(),
                 b.labels.size() * sizeof(int32_t));
@@ -106,10 +272,13 @@ class Prefetcher {
 
  private:
   void run() {
+    journal(kJopWorkerEnter, n_batches_, local_batch_);
     for (int64_t s = 0; s < n_batches_; ++s) {
       Batch b;
       b.images.resize(static_cast<size_t>(local_batch_ * row_bytes_));
       b.labels.resize(static_cast<size_t>(local_batch_));
+      journal(kJopBatchAlloc, s,
+              static_cast<int64_t>(b.images.size()));
       const int64_t* idx = idx_.data() + s * local_batch_;
       gather_rows_impl(images_, idx, local_batch_, row_bytes_,
                        b.images.data(), n_threads_);
@@ -118,14 +287,20 @@ class Prefetcher {
       space_cv_.wait(lk, [&] {
         return stopping_ || static_cast<int>(ring_.size()) < depth_;
       });
-      if (stopping_) return;
+      if (stopping_) {
+        journal(kJopWorkerExit, s, 1);
+        return;
+      }
       ring_.push_back(std::move(b));
       lk.unlock();
       ready_cv_.notify_one();
+      journal(kJopBatchPush, s, 0);
     }
+    journal(kJopWorkerExit, n_batches_, 0);
   }
 
   void stop() {
+    journal(kJopStopBegin, consumed_, n_batches_);
     {
       std::lock_guard<std::mutex> lk(mu_);
       stopping_ = true;
@@ -134,6 +309,7 @@ class Prefetcher {
     if (worker_.joinable()) worker_.join();
     std::lock_guard<std::mutex> lk(mu_);
     ring_.clear();
+    journal(kJopStopJoined, consumed_, n_batches_);
   }
 
   const uint8_t* images_;
@@ -181,6 +357,47 @@ int tn_prefetcher_next(void* p, uint8_t* out_images, int32_t* out_labels) {
 
 void tn_prefetcher_destroy(void* p) { delete static_cast<Prefetcher*>(p); }
 
-int tn_abi_version() { return 1; }
+// -- flight-recorder surface (tpunet/obs/flightrec/) ------------------------
+
+// Live snapshot of the op journal, oldest-first, into a caller buffer
+// laid out exactly like JournalEntry (seq u64, op u32, tid u32, a i64,
+// b i64 — 32 bytes packed; ctypes mirrors it in tpunet/data/native.py).
+int tn_journal_read(void* out, int max_entries) {
+  return journal_snapshot(static_cast<JournalEntry*>(out), max_entries);
+}
+
+// Arm the crash spill: on SIGSEGV/SIGABRT/SIGBUS, write the journal as
+// text to `path`, then chain to the previously installed handler.
+// Install AFTER faulthandler so the chain is journal -> Python stacks
+// -> default action. Re-install is allowed — and necessary: each
+// faulthandler.enable() re-registers ITS handlers over ours, so a new
+// recorder install must re-arm. The captured "previous" handler is
+// only adopted as the chain target when it is not this handler itself
+// (a double install with no faulthandler in between must not make the
+// chain loop back into us forever).
+int tn_crash_install(const char* path) {
+  if (!path || !path[0] ||
+      std::strlen(path) >= sizeof(g_crash_path)) {
+    return -1;
+  }
+  std::strncpy(g_crash_path, path, sizeof(g_crash_path) - 1);
+  struct sigaction sa;
+  std::memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = crash_handler;
+  sigemptyset(&sa.sa_mask);
+  // SA_ONSTACK: run on faulthandler's alternate stack when one is
+  // configured, so stack-overflow SIGSEGVs still capture.
+  sa.sa_flags = SA_SIGINFO | SA_ONSTACK;
+  for (int i = 0; i < 3; ++i) {
+    struct sigaction prev;
+    if (sigaction(g_crash_sigs[i], &sa, &prev) != 0) return -1;
+    const bool self =
+        (prev.sa_flags & SA_SIGINFO) && prev.sa_sigaction == crash_handler;
+    if (!self) g_old_sa[i] = prev;  // first install: zero-init = SIG_DFL
+  }
+  return 0;
+}
+
+int tn_abi_version() { return 2; }
 
 }  // extern "C"

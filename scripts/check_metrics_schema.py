@@ -157,6 +157,41 @@ def collect_obs_records(tmpdir: str) -> list:
         reg.gauge("some_gauge").set(5.0 + i)
         clock.t += 1.0
         wd.check_gauges(44 + i, reg.snapshot())
+    # thread_stalled: a registered host thread busy past its budget.
+    from tpunet.obs.flightrec.threads import THREADS
+    handle = THREADS.register("schema-check", stall_after_s=1.0,
+                              clock=clock)
+    try:
+        handle.beat("busy")
+        clock.t += 10.0
+        wd.check_threads(50)
+    finally:
+        THREADS.unregister("schema-check")
+    return sink.records
+
+
+def collect_crash_records(tmpdir: str) -> list:
+    """obs_crash via the real path: a flightrec artifact dir is
+    assembled into a report, detected as a prior crash, and emitted."""
+    from tpunet.obs import flightrec
+    from tpunet.obs.flightrec import report as frreport
+    from tpunet.obs.registry import MemorySink, Registry
+
+    rec = flightrec.FlightRecorder(tmpdir, watcher=False, native=False)
+    rec.install()
+    rec.record("span", "step 1")
+    rec.refresh_threads()
+    frreport.write_report(rec.directory)
+    rep, path = flightrec.prior_crash_report(tmpdir)
+    # Close NOW (restores faulthandler, releases the stacks file):
+    # the recorder must not outlive the tmpdir it points into.
+    rec.close()
+    assert rep is not None
+    reg = Registry()
+    reg.set_identity(run_id="crash-check", process_index=0, host="h")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    reg.emit("obs_crash", flightrec.crash_record(rep, path))
     return sink.records
 
 
@@ -231,7 +266,12 @@ def collect_agg_records() -> list:
         agg.ingest({"kind": "obs_alert", "run_id": name,
                     "process_index": 0, "reason": "step_stall",
                     "step": 5, "severity": "warn"})
-    agg.emit_rollup()           # straggler + mem_growth + rules
+    agg.ingest({"kind": "obs_crash", "run_id": "a",
+                "process_index": 0, "cause": "SIGSEGV", "signal": 11,
+                "report_path": "/tmp/x.json", "crashed_pid": 1,
+                "events": 3, "stack_threads": 2, "native_ops": 5,
+                "assembled_t": 1.0})      # crash alert + crashes_total
+    agg.emit_rollup()           # straggler + mem_growth + rules + crash
     clock.t += 100.0
     agg.emit_rollup()           # stream_stale for every stream
     return sink.records
@@ -261,6 +301,8 @@ def main() -> int:
     records = []
     with tempfile.TemporaryDirectory() as tmp:
         records += collect_obs_records(tmp)
+    with tempfile.TemporaryDirectory() as tmp:
+        records += collect_crash_records(tmp)
     records += collect_serve_records()
     records += collect_agg_records()
     emitted_kinds = sorted({r.get("kind", PLAIN) for r in records})

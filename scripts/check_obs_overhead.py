@@ -5,8 +5,13 @@ be within noise of a fully disabled one.
 The obs design promise (tpunet/obs/__init__.py) is that the default
 path adds no device syncs and only host-side ``perf_counter`` laps per
 step; this drives the same tiny-LM step loop both ways and fails if
-the instrumented loop is measurably slower. Standalone (not collected
-by pytest) so tier-1 wall time is unaffected:
+the instrumented loop is measurably slower. Since the flight recorder
+(tpunet/obs/flightrec/) is default-ON, a third variant isolates it:
+``default`` (recorder on) vs ``no-flightrec`` (same obs config,
+recorder off) is the recorder's own A/B — its design budget is well
+under the subsystem's 0.5% measured overhead bar (two mmap writes per
+span, no syscalls on the step path). Standalone (not collected by
+pytest) so tier-1 wall time is unaffected:
 
     JAX_PLATFORMS=cpu python scripts/check_obs_overhead.py
 """
@@ -28,7 +33,8 @@ MAX_RATIO = 1.20
 EPOCHS_MEASURED = 5
 
 
-def build_trainer(obs_enabled: bool, workdir: str):
+def build_trainer(obs_enabled: bool, workdir: str,
+                  flightrec: bool = True):
     from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
                                ModelConfig, ObsConfig, OptimConfig,
                                TrainConfig)
@@ -46,7 +52,7 @@ def build_trainer(obs_enabled: bool, workdir: str):
         mesh=MeshConfig(),
         checkpoint=CheckpointConfig(directory=workdir, save_best=False,
                                     save_last=False),
-        obs=ObsConfig(enabled=obs_enabled),
+        obs=ObsConfig(enabled=obs_enabled, flightrec=flightrec),
     )
     return Trainer(cfg)
 
@@ -64,22 +70,36 @@ def time_epochs(trainer) -> list:
 
 def main() -> int:
     results = {}
-    for label, enabled in (("disabled", False), ("default", True)):
+    for label, enabled, rec in (("disabled", False, False),
+                                ("no-flightrec", True, False),
+                                ("default", True, True)):
         with tempfile.TemporaryDirectory() as d:
-            trainer = build_trainer(enabled, d)
+            trainer = build_trainer(enabled, d, flightrec=rec)
             try:
                 results[label] = time_epochs(trainer)
             finally:
                 trainer.close()
     off = statistics.median(results["disabled"])
+    bare = statistics.median(results["no-flightrec"])
     on = statistics.median(results["default"])
     ratio = on / off if off > 0 else float("inf")
+    rec_ratio = on / bare if bare > 0 else float("inf")
     print(f"epoch median: obs-disabled {off * 1e3:.1f}ms, "
-          f"obs-default {on * 1e3:.1f}ms, ratio {ratio:.3f} "
+          f"obs-no-flightrec {bare * 1e3:.1f}ms, "
+          f"obs-default {on * 1e3:.1f}ms")
+    print(f"obs-vs-disabled ratio {ratio:.3f}, flightrec-on-vs-off "
+          f"ratio {rec_ratio:.3f} ({100 * (rec_ratio - 1):+.2f}%) "
           f"(threshold {MAX_RATIO})")
+    fail = False
     if ratio > MAX_RATIO:
         print("FAIL: default observability path exceeds the overhead "
               "budget", file=sys.stderr)
+        fail = True
+    if rec_ratio > MAX_RATIO:
+        print("FAIL: the flight recorder alone exceeds the overhead "
+              "budget", file=sys.stderr)
+        fail = True
+    if fail:
         return 1
     print("OK")
     return 0

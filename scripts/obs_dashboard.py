@@ -369,6 +369,27 @@ def _ms(v) -> str:
     return "-" if v is None else f"{v * 1e3:.1f}"
 
 
+def _fleet_alerts(agg):
+    """What the fleet alert panels show: the bridge's own fleet-scope
+    alerts plus per-run pages ingested from the streams
+    (thread_stalled, step_stall, ...) and each stream's last crash —
+    deduplicated so a crash the bridge already paged is one row, not
+    two — but keyed on the distinguishing detail too (thread name,
+    report path), so two stalled threads of one stream stay two rows
+    (the per-thread semantics tpunet/obs/health.py promises)."""
+    def key(a):
+        return (a.get("reason"), a.get("stream"), a.get("thread"),
+                a.get("report_path"))
+
+    alerts = list(agg.bridge.alerts)
+    seen = {key(a) for a in alerts}
+    for a in agg.recent_alerts():
+        if key(a) not in seen:
+            seen.add(key(a))
+            alerts.append(a)
+    return alerts
+
+
 def render_fleet_terminal(rollup: dict, ages: dict, source: str,
                           alerts=()) -> str:
     """One text frame of the fleet rollup + per-stream table."""
@@ -387,15 +408,23 @@ def render_fleet_terminal(rollup: dict, ages: dict, source: str,
         head.append(f"straggler x{rollup['straggler_factor']:.2f}")
     if rollup.get("serve_queue_depth") is not None:
         head.append(f"queue {rollup['serve_queue_depth']}")
+    if rollup.get("crashes_total"):
+        head.append(f"CRASHES {rollup['crashes_total']}")
     out.append("  ".join(head))
     out.append("")
 
     if alerts:
         out.append(f"FLEET ALERTS ({len(alerts)}):")
-        for a in alerts[-5:]:
+        for a in alerts[-8:]:
+            extra = ""
+            if a.get("reason") == "crash":
+                extra = f" {a.get('cause', '')}"
+            elif a.get("reason") == "thread_stalled":
+                extra = (f" {a.get('thread', '')} "
+                         f"{a.get('age_s', '')}s")
             out.append(f"  [{a.get('scope', '?'):>6}] "
                        f"{a.get('reason', '?')} "
-                       f"{a.get('stream', '')}")
+                       f"{a.get('stream', '')}{extra}")
         out.append("")
 
     rows = rollup.get("per_stream", [])
@@ -458,6 +487,8 @@ def render_fleet_html(rollup: dict, streams, source: str,
     if rollup.get("step_lag") is not None:
         tile(rollup["step_lag"], "step lag")
     tile(rollup.get("alerts_total", 0) + len(alerts), "alerts")
+    if rollup.get("crashes_total"):
+        tile(rollup["crashes_total"], "crashes")
 
     cards = []
     # Per-stream step-time trend: one line per stream, shared y scale.
@@ -654,7 +685,7 @@ def serve_http(port: int, buf: RecordBuffer, source_name: str,
             if agg is not None:
                 text = render_fleet_terminal(
                     agg.rollup(), agg.heartbeat_ages(), source_name,
-                    alerts=agg.bridge.alerts)
+                    alerts=_fleet_alerts(agg))
             else:
                 text = render_terminal(summarize(buf.snapshot()),
                                        source_name)
@@ -787,13 +818,13 @@ def main(argv=None) -> int:
         if agg is not None:
             return render_fleet_terminal(view, agg.heartbeat_ages(),
                                          source,
-                                         alerts=agg.bridge.alerts)
+                                         alerts=_fleet_alerts(agg))
         return render_terminal(view, source, last=args.last)
 
     def render_page(view):
         if agg is not None:
             return render_fleet_html(view, agg.streams(), source,
-                                     alerts=agg.bridge.alerts)
+                                     alerts=_fleet_alerts(agg))
         return render_html(view, source)
 
     view = refresh()

@@ -1,0 +1,482 @@
+"""Flight recorder (tpunet/obs/flightrec/): ring semantics under
+concurrency, the host-thread registry + thread_stalled watchdog path,
+and the acceptance test — a child process driven to SIGSEGV/SIGABRT
+leaves a complete, parseable crash_report.json (ring tail, per-thread
+Python stacks, native batcher journal)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpunet.obs.flightrec.ring import (EventRing, read_ring_file,
+                                       read_slots)
+from tpunet.obs.flightrec.threads import ThreadRegistry
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ---------------------------------------------------------------------------
+# event ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_roundtrip_and_order(tmp_path):
+    path = str(tmp_path / "events.ring")
+    ring = EventRing(path, n_slots=16)
+    for i in range(5):
+        ring.record("kind", f"msg {i}")
+    tail = ring.tail()
+    assert [e["msg"] for e in tail] == [f"msg {i}" for i in range(5)]
+    assert [e["seq"] for e in tail] == [1, 2, 3, 4, 5]
+    assert all(e["kind"] == "kind" for e in tail)
+    assert tail[0]["tid"] == threading.get_ident()
+    # Bounded tail request.
+    assert [e["seq"] for e in ring.tail(2)] == [4, 5]
+    ring.close()
+
+
+def test_ring_wraparound_keeps_newest(tmp_path):
+    ring = EventRing(str(tmp_path / "r.ring"), n_slots=8)
+    for i in range(20):
+        ring.record("k", f"m{i}")
+    tail = ring.tail()
+    assert len(tail) == 8
+    assert [e["seq"] for e in tail] == list(range(13, 21))
+    assert tail[-1]["msg"] == "m19"
+    ring.close()
+
+
+def test_ring_survives_without_close(tmp_path):
+    """The crash property: slots are durable in the file the moment
+    record() returns — a reader parses them with no shutdown step."""
+    path = str(tmp_path / "r.ring")
+    ring = EventRing(path, n_slots=8)
+    ring.record("span", "step 1")
+    ring.record("alert", "nan_loss step=3")
+    events = read_ring_file(path)          # file read, not the mmap
+    assert [e["kind"] for e in events] == ["span", "alert"]
+    ring.close()
+
+
+def test_ring_anonymous_mode_and_long_payload_truncation():
+    ring = EventRing(None, n_slots=4)
+    ring.record("k" * 40, "x" * 500)       # over the 16/80-byte slots
+    (e,) = ring.tail()
+    assert e["kind"] == "k" * 16
+    assert e["msg"] == "x" * 80
+    ring.close()
+
+
+def test_ring_rejects_garbage_buffers():
+    assert read_slots(b"") == []
+    assert read_slots(b"not a ring at all" * 10) == []
+    assert read_ring_file("/nonexistent/path.ring") == []
+
+
+def test_ring_concurrent_writers_lose_nothing(tmp_path):
+    """8 threads hammer one ring: every write claims a distinct seq
+    (the itertools.count cursor is atomic under the GIL) and the final
+    tail parses with the highest seqs intact."""
+    ring = EventRing(str(tmp_path / "c.ring"), n_slots=256)
+    n_threads, per = 8, 500
+
+    def writer(t):
+        for i in range(per):
+            ring.record("conc", f"t{t} i{i}")
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tail = ring.tail()
+    assert len(tail) == 256
+    seqs = [e["seq"] for e in tail]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 256
+    assert max(seqs) == n_threads * per
+    # Every surviving slot parses back to a well-formed payload.
+    assert all(e["kind"] == "conc" and e["msg"].startswith("t")
+               for e in tail)
+    ring.close()
+
+
+def test_record_after_close_is_silent(tmp_path):
+    ring = EventRing(str(tmp_path / "r.ring"), n_slots=4)
+    ring.close()
+    ring.record("k", "never raises")       # must not throw
+
+
+# ---------------------------------------------------------------------------
+# host-thread registry + thread_stalled
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_thread_registry_beat_state_and_stall():
+    clock = FakeClock()
+    reg = ThreadRegistry()
+    h = reg.register("worker", stall_after_s=5.0, clock=clock)
+    h.beat("busy")
+    clock.t += 3.0
+    assert not h.stalled()                 # within budget
+    clock.t += 3.0
+    assert h.stalled()                     # busy past budget
+    h.beat("idle")
+    clock.t += 100.0
+    assert not h.stalled()                 # idle never stalls
+    assert reg.stalled() == []
+    h.beat("busy")
+    clock.t += 6.0
+    assert [(x.name, round(a)) for x, a in reg.stalled()] \
+        == [("worker", 6)]
+
+
+def test_thread_registry_gauges_and_snapshot():
+    from tpunet.obs.registry import Registry
+    clock = FakeClock()
+    treg = ThreadRegistry()
+    h = treg.register("ckpt-writer", stall_after_s=600.0, clock=clock)
+    h.beat("busy")
+    clock.t += 2.0
+    reg = Registry()
+    treg.export_gauges(reg)
+    snap = reg.snapshot()
+    assert snap["thread_count"] == 1
+    assert snap["thread_ckpt_writer_age_s"] == pytest.approx(2.0)
+    assert snap["thread_ckpt_writer_beats"] == 1
+    (row,) = treg.snapshot()
+    assert row["name"] == "ckpt-writer" and row["state"] == "busy"
+    # Re-registration replaces (thread restart), unregister removes.
+    treg.register("ckpt-writer", clock=clock)
+    assert treg.handles()[0].beats == 0
+    treg.unregister("ckpt-writer")
+    assert treg.handles() == []
+
+
+def test_watchdog_thread_stalled_per_thread_cooldown(monkeypatch):
+    """Two stalled threads page separately (per-thread cooldown
+    keys); a repeat within the cooldown is suppressed; the alert
+    reaches the registry sinks like every other watchdog page."""
+    import dataclasses
+
+    from tpunet.config import ObsConfig
+    from tpunet.obs import flightrec
+    from tpunet.obs.health import Watchdog
+    from tpunet.obs.registry import MemorySink, Registry
+
+    clock = FakeClock()
+    treg = ThreadRegistry()
+    monkeypatch.setattr(
+        "tpunet.obs.flightrec.threads.THREADS", treg)
+    assert flightrec  # the watchdog resolves THREADS through here
+    a = treg.register("writer-a", stall_after_s=1.0, clock=clock)
+    b = treg.register("writer-b", stall_after_s=1.0, clock=clock)
+    cfg = dataclasses.replace(ObsConfig(), alert_cooldown_steps=10)
+    reg = Registry()
+    sink = MemorySink()
+    reg.add_sink(sink)
+    wd = Watchdog(cfg, reg, clock=clock)
+    a.beat("busy")
+    b.beat("busy")
+    clock.t += 5.0
+    wd.check_threads(step=100)
+    alerts = sink.by_kind("obs_alert")
+    assert {al["thread"] for al in alerts} == {"writer-a", "writer-b"}
+    assert all(al["reason"] == "thread_stalled"
+               and al["severity"] == "warn" for al in alerts)
+    assert alerts[0]["age_s"] == pytest.approx(5.0)
+    # Inside the cooldown window: suppressed, counted.
+    wd.check_threads(step=105)
+    assert len(sink.by_kind("obs_alert")) == 2
+    assert reg.counter("obs_alerts_suppressed").value == 2
+    # Past the cooldown: pages again.
+    wd.check_threads(step=111)
+    assert len(sink.by_kind("obs_alert")) == 4
+
+
+def test_watchdog_checks_threads_from_observe_step(monkeypatch):
+    import dataclasses
+
+    from tpunet.config import ObsConfig
+    from tpunet.obs.health import Watchdog
+    from tpunet.obs.registry import MemorySink, Registry
+
+    clock = FakeClock()
+    treg = ThreadRegistry()
+    monkeypatch.setattr("tpunet.obs.flightrec.threads.THREADS", treg)
+    h = treg.register("wedged", stall_after_s=1.0, clock=clock)
+    h.beat("busy")
+    clock.t += 10.0
+    reg = Registry()
+    sink = MemorySink()
+    reg.add_sink(sink)
+    wd = Watchdog(dataclasses.replace(ObsConfig(), stall_factor=0.0),
+                  reg, clock=clock)
+    # observe_step piggybacks the check every THREAD_CHECK_STEPS.
+    wd.observe_step(Watchdog.THREAD_CHECK_STEPS, 0.01)
+    assert [a["reason"] for a in sink.by_kind("obs_alert")] \
+        == ["thread_stalled"]
+
+
+# ---------------------------------------------------------------------------
+# crash capture end-to-end (the acceptance test)
+# ---------------------------------------------------------------------------
+
+
+_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from tpunet.obs import flightrec
+
+rec = flightrec.install({workdir!r})
+handle = flightrec.register_thread("child-worker", stall_after_s=60.0)
+handle.beat("busy")
+rec.refresh_threads()
+for i in range(5):
+    flightrec.record("span", f"step {{i}}")
+
+native_ok = False
+try:
+    from tpunet.data import native
+    if native.available():
+        rows = np.arange(64 * 12, dtype=np.uint8).reshape(64, 12)
+        pf = native.NativePrefetcher(rows,
+                                     np.arange(64, dtype=np.int32), 8)
+        next(pf.iter_epoch(np.arange(64)))
+        native_ok = True
+except Exception:
+    pass
+print("NATIVE_OK" if native_ok else "NATIVE_MISSING", flush=True)
+flightrec.record("test", "about to die: {mode}")
+{die}
+"""
+
+_DIE = {
+    "sigsegv": "import ctypes; ctypes.string_at(0)",
+    "sigabrt": "os.abort()",
+}
+
+
+def _run_crash_child(tmp_path, mode):
+    workdir = str(tmp_path / mode)
+    code = _CHILD.format(repo=REPO, workdir=workdir,
+                         die=_DIE[mode], mode=mode)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env,
+                          timeout=180)
+    report_path = os.path.join(workdir, "flightrec",
+                               "crash_report.json")
+    # The watcher outlives the child; give it a moment to assemble.
+    deadline = time.monotonic() + 20.0
+    while not os.path.exists(report_path) \
+            and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert os.path.exists(report_path), (
+        f"no crash report after {mode} child "
+        f"(rc={proc.returncode})\nstdout: {proc.stdout}\n"
+        f"stderr: {proc.stderr}")
+    with open(report_path) as f:
+        return proc, json.load(f)
+
+
+@pytest.mark.parametrize("mode,signo", [("sigsegv", signal.SIGSEGV),
+                                        ("sigabrt", signal.SIGABRT)])
+def test_induced_crash_produces_complete_report(tmp_path, mode, signo):
+    proc, rep = _run_crash_child(tmp_path, mode)
+    assert proc.returncode != 0            # the child really died
+    native_built = "NATIVE_OK" in proc.stdout
+    # Ring tail: the events recorded before death, in order, ending
+    # with the last breath.
+    msgs = [e["msg"] for e in rep["events"]]
+    assert f"about to die: {mode}" in msgs[-1]
+    assert sum(m.startswith("step ") for m in msgs) == 5
+    # Per-thread Python stacks from faulthandler.
+    assert rep["stacks"]["fatal"]
+    assert len(rep["stacks"]["threads"]) >= 1
+    frames = [f for t in rep["stacks"]["threads"]
+              for f in t["frames"]]
+    assert any("File" in f for f in frames)
+    # Host-thread registry snapshot.
+    assert any(t["name"] == "child-worker" for t in rep["threads"])
+    # Native journal: present whenever the extension was loadable —
+    # including the signal the C handler saw.
+    if native_built:
+        nj = rep["native_journal"]
+        assert nj is not None and nj["signal"] == int(signo)
+        ops = [o["op"] for o in nj["ops"]]
+        assert "create" in ops and "batch_alloc" in ops
+        assert rep["cause"] == signal.Signals(signo).name
+    # Meta identifies the dead incarnation.
+    assert isinstance(rep["meta"]["pid"], int) and rep["meta"]["pid"] > 0
+
+
+def test_clean_close_leaves_no_crash_report(tmp_path):
+    """A clean shutdown must not fabricate a crash."""
+    code = (f"import sys; sys.path.insert(0, {REPO!r})\n"
+            "import os; os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "from tpunet.obs import flightrec\n"
+            f"rec = flightrec.install({str(tmp_path / 'clean')!r})\n"
+            "flightrec.record('k', 'fine')\n"
+            "flightrec.close()\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env,
+                          timeout=180)
+    assert proc.returncode == 0, proc.stderr
+    time.sleep(1.0)                        # watcher shutdown window
+    flightdir = tmp_path / "clean" / "flightrec"
+    assert (flightdir / "clean").exists()
+    assert not (flightdir / "crash_report.json").exists()
+
+
+def test_watcher_ownership_and_clean_protocol(tmp_path):
+    """watch.main directly: EOF after CLEAN assembles nothing; EOF on
+    a dir whose meta.json names a NEWER pid assembles nothing (run
+    dirs are reused — a lingering predecessor watcher must not write
+    over the successor's artifacts); matching pid assembles."""
+    import io
+
+    from tpunet.obs.flightrec import report as frreport
+    from tpunet.obs.flightrec import watch
+
+    # A dir with a space exercises the remainder-of-line path field.
+    d = str(tmp_path / "my runs")
+    os.makedirs(d)
+    with open(frreport.artifact(d, frreport.META_JSON), "w") as f:
+        json.dump({"pid": 999}, f)
+    report = frreport.artifact(d, frreport.REPORT_NAME)
+    # Stale watcher (pid 123) vs newer incarnation (meta pid 999).
+    assert watch.main(io.StringIO(f"DIR 0 123 {d}\n")) == 0
+    assert not os.path.exists(report)
+    # CLEAN clears the dir: nothing assembled even for the owner.
+    assert watch.main(io.StringIO(f"DIR 0 999 {d}\nCLEAN\n")) == 0
+    assert not os.path.exists(report)
+    # A malformed line is skipped, not fatal; the owning
+    # incarnation's watcher then assembles on EOF.
+    assert watch.main(io.StringIO(
+        f"DIR not-an-int x {d}\nDIR 0 999 {d}\n")) == 0
+    assert os.path.exists(report)
+    with open(report) as f:
+        assert json.load(f)["meta"]["pid"] == 999
+
+
+def test_native_journal_live_snapshot():
+    """tn_journal_read: the in-process view of the native op ring
+    (the crash handler's spill is the post-mortem view of the same
+    ring, exercised by the crash children above)."""
+    from tpunet.data import native
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    import numpy as np
+    native.gather_rows(np.zeros((4, 4), np.uint8), np.arange(4))
+    entries = native.journal_entries()
+    assert any(e["op"] == "gather" for e in entries)
+    seqs = [e["seq"] for e in entries]
+    assert seqs == sorted(seqs) and all(s > 0 for s in seqs)
+
+
+# ---------------------------------------------------------------------------
+# prior-crash detection -> obs_crash emission
+# ---------------------------------------------------------------------------
+
+
+def test_prior_crash_emits_obs_crash_once(tmp_path):
+    """A restart over a crashed run dir emits exactly one obs_crash
+    (and archives the report so the next restart emits none)."""
+    from tpunet.config import ObsConfig
+    from tpunet.obs import Observability, flightrec
+    from tpunet.obs.registry import MemorySink
+
+    workdir = str(tmp_path)
+    flightdir = tmp_path / "flightrec"
+    flightdir.mkdir()
+    with open(flightdir / "crash_report.json", "w") as f:
+        json.dump({"version": 1, "cause": "SIGSEGV", "signal": 11,
+                   "meta": {"pid": 1234},
+                   "events": [{"seq": 1, "kind": "k", "msg": "m"}],
+                   "stacks": {"threads": [{"frames": []}]},
+                   "native_journal": {"ops": [{"seq": 1}]}}, f)
+    obs = Observability(ObsConfig(), checkpoint_dir=workdir)
+    try:
+        sink = MemorySink()
+        obs.add_sink(sink)
+        obs.begin_epoch(1)
+        (rec,) = sink.by_kind("obs_crash")
+        assert rec["cause"] == "SIGSEGV" and rec["signal"] == 11
+        assert rec["crashed_pid"] == 1234
+        assert rec["events"] == 1 and rec["stack_threads"] == 1
+        assert rec["native_ops"] == 1
+        assert os.path.exists(rec["report_path"])
+        assert not (flightdir / "crash_report.json").exists()
+        obs.begin_epoch(2)                 # no double emission
+        assert len(sink.by_kind("obs_crash")) == 1
+    finally:
+        obs.close()
+    # A fresh incarnation over the ARCHIVED report emits nothing.
+    obs2 = Observability(ObsConfig(), checkpoint_dir=workdir)
+    try:
+        sink2 = MemorySink()
+        obs2.add_sink(sink2)
+        obs2.begin_epoch(1)
+        assert sink2.by_kind("obs_crash") == []
+    finally:
+        obs2.close()
+
+
+def test_crash_report_renderer(tmp_path):
+    """scripts/obs_crash_report.py resolves run dirs and renders the
+    sections a post-mortem needs."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        occ = __import__("obs_crash_report")
+    finally:
+        sys.path.pop(0)
+    flightdir = tmp_path / "flightrec"
+    flightdir.mkdir()
+    rep = {"version": 1, "cause": "SIGABRT", "signal": 6,
+           "assembled_t": 1e9,
+           "meta": {"pid": 7, "argv": ["train.py"], "run_id": "r1",
+                    "started_t": 1e9},
+           "events": [{"seq": 1, "t": 1e9, "kind": "span",
+                       "msg": "step 1"}],
+           "threads": [{"name": "ckpt-writer", "state": "busy",
+                        "age_s": 2.0, "beats": 3,
+                        "stall_after_s": 600.0}],
+           "stacks": {"fatal": "Aborted", "threads": [
+               {"ident": "0x1", "current": True,
+                "frames": ['File "x.py", line 1 in f']}]},
+           "native_journal": {"signal": 6, "ops": [
+               {"seq": 1, "op": "create", "tid": 1, "a": 8, "b": 4}]},
+           "device_memory": {"sampled_t": 1e9, "devices": [
+               {"device": 0, "bytes_in_use": 2 ** 20,
+                "peak_bytes_in_use": 2 ** 21}]}}
+    with open(flightdir / "crash_report.json", "w") as f:
+        json.dump(rep, f)
+    path = occ.find_report(str(tmp_path))
+    text = occ.render(rep, path)
+    for needle in ("SIGABRT", "ckpt-writer", "PYTHON STACKS",
+                   "EVENT RING TAIL", "NATIVE BATCHER JOURNAL",
+                   "DEVICE MEMORY", "run_id: r1"):
+        assert needle in text, needle
+    # Archived-only dirs resolve to the newest archive.
+    os.rename(flightdir / "crash_report.json",
+              flightdir / "crash_report.123.json")
+    assert occ.find_report(str(tmp_path)).endswith(
+        "crash_report.123.json")

@@ -133,19 +133,20 @@ def test_lm_trainer_uses_native_loader_with_identical_metrics():
     assert results[True]["count"] == results[False]["count"]
 
 
-def test_resume_falls_back_to_python_loader(tmp_path, monkeypatch, capsys):
-    """KNOWN BUG GUARD (ROADMAP): --resume + the native C++ prefetcher
-    crashed with glibc heap corruption on a single-core host. Until
-    root-caused, a resumed run must get the numpy loader (with a loud
-    warning), never a possible SIGSEGV; TPUNET_NATIVE_RESUME=1 is the
-    opt-back-in escape hatch."""
+def test_resume_keeps_native_loader(tmp_path):
+    """The resume heap-corruption bug that used to force a numpy
+    fallback here was root-caused to buffer donation of orbax-restored
+    state and fixed in Checkpointer.restore_state (re-materializing
+    restored arrays — see the flight-recorder A/B in
+    runs/flightrec-repro-r7): the prefetcher was innocent, so resumed
+    runs keep the native path and the resumed epoch trains."""
     from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
                                ModelConfig, OptimConfig, TrainConfig)
     from tpunet.train.loop import Trainer
 
     def cfg(resume):
         return TrainConfig(
-            epochs=1,
+            epochs=2,
             data=DataConfig(dataset="synthetic", batch_size=16,
                             synthetic_train_size=32,
                             synthetic_test_size=16, image_size=32,
@@ -154,31 +155,26 @@ def test_resume_falls_back_to_python_loader(tmp_path, monkeypatch, capsys):
             optim=OptimConfig(),
             mesh=MeshConfig(),
             checkpoint=CheckpointConfig(directory=str(tmp_path),
-                                        save_best=False, save_last=False,
-                                        resume=resume),
+                                        save_best=False, resume=resume),
         )
 
-    monkeypatch.delenv("TPUNET_NATIVE_RESUME", raising=False)
     fresh = Trainer(cfg(resume=False))
     try:
         assert fresh._prefetcher is not None  # fresh runs keep native
+        fresh.train_one_epoch(1)
+        fresh.start_epoch = 1
+        fresh.ckpt.save_state(1, fresh._payload())
+        fresh.ckpt.wait()
     finally:
         fresh.close()
 
     resumed = Trainer(cfg(resume=True))
     try:
-        assert resumed._prefetcher is None    # guarded fallback
-        out = capsys.readouterr().out
-        assert "TPUNET_NATIVE_RESUME" in out  # loud, actionable warning
-        # ...and the fallback epoch actually trains.
-        m = resumed.train_one_epoch(1)
+        assert resumed._prefetcher is not None  # native on resume too
+        assert resumed.start_epoch == 2
+        # The post-resume epoch — the donated-restored-state window
+        # the old bug lived in — trains through the native path.
+        m = resumed.train_one_epoch(2)
         assert m["count"] == 32
     finally:
         resumed.close()
-
-    monkeypatch.setenv("TPUNET_NATIVE_RESUME", "1")
-    forced = Trainer(cfg(resume=True))
-    try:
-        assert forced._prefetcher is not None  # escape hatch honored
-    finally:
-        forced.close()

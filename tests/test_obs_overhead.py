@@ -26,9 +26,36 @@ def _import_script(name):
 
 
 def test_obs_default_path_overhead_within_budget(monkeypatch):
+    # main() now measures three variants (disabled / obs-no-flightrec /
+    # default) and gates both the whole-subsystem ratio and the
+    # flight-recorder-only A/B.
     check = _import_script("check_obs_overhead")
     monkeypatch.setattr(check, "MAX_RATIO", 1.5)   # generous for CI
     assert check.main() == 0
+
+
+def test_flightrec_on_vs_off_ab(monkeypatch):
+    """The default-ON flight recorder's own regression gate: same obs
+    config, recorder on vs off, same step loop — a recorder that grew
+    a per-step syscall or sync shows up as 2x+, not percent noise."""
+    import statistics
+    import tempfile
+
+    check = _import_script("check_obs_overhead")
+    results = {}
+    for label, rec in (("off", False), ("on", True)):
+        with tempfile.TemporaryDirectory() as d:
+            trainer = check.build_trainer(True, d, flightrec=rec)
+            try:
+                results[label] = check.time_epochs(trainer)
+            finally:
+                trainer.close()
+    off = statistics.median(results["off"])
+    on = statistics.median(results["on"])
+    ratio = on / off if off > 0 else float("inf")
+    assert ratio < 1.5, (
+        f"flight recorder slowed the step loop {ratio:.2f}x "
+        f"(off {off * 1e3:.1f}ms, on {on * 1e3:.1f}ms)")
 
 
 def test_obs_overhead_with_dead_http_endpoint(tmp_path, monkeypatch):

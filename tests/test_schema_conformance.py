@@ -25,7 +25,29 @@ def test_every_emitted_kind_and_field_is_documented(capsys):
     assert rc == 0, f"schema drift:\n{out.err}"
     # The harness actually exercised every layer.
     assert "obs_epoch" in out.out and "obs_serve" in out.out \
-        and "obs_fleet" in out.out and "obs_alert" in out.out
+        and "obs_fleet" in out.out and "obs_alert" in out.out \
+        and "obs_crash" in out.out
+
+
+def test_thread_stalled_and_crash_reasons_emitted(tmp_path):
+    """The new emission paths actually fire in the harness: a
+    thread_stalled obs_alert from the watchdog, and an obs_crash from
+    the prior-crash detection path."""
+    checker = _import_checker()
+    records = checker.collect_obs_records(str(tmp_path / "obs"))
+    reasons = {r.get("reason") for r in records
+               if r.get("kind") == "obs_alert"}
+    assert "thread_stalled" in reasons
+    crash = checker.collect_crash_records(str(tmp_path / "crash"))
+    assert [r["kind"] for r in crash] == ["obs_crash"]
+    assert crash[0]["report_path"].endswith(".json")
+    # The fleet side pages on the ingested obs_crash.
+    agg_records = checker.collect_agg_records()
+    fleet_reasons = {r.get("reason") for r in agg_records
+                     if r.get("kind") == "obs_alert"}
+    assert "crash" in fleet_reasons
+    rollups = [r for r in agg_records if r.get("kind") == "obs_fleet"]
+    assert any(r.get("crashes_total") for r in rollups)
 
 
 def test_checker_catches_drift():

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from tpunet.config import CheckpointConfig
+from tpunet.obs import flightrec
 
 
 def _snapshot(tree):
@@ -92,6 +93,21 @@ class Checkpointer:
         if self._pool is None:
             self._pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="tpunet-ckpt")
+            # Host-thread registry: the orbax writer is the
+            # longest-lived background competitor of the step loop —
+            # register it with a generous budget (a multi-GB sharded
+            # save can legitimately take minutes; past that, page).
+            self._thread = flightrec.register_thread(
+                "ckpt-writer", stall_after_s=600.0)
+
+        def run(fn=fn):
+            self._thread.beat("busy")
+            flightrec.record("ckpt", "save begin")
+            try:
+                fn()
+            finally:
+                self._thread.beat("idle")
+                flightrec.record("ckpt", "save end")
         # Back-pressure: each queued save pins an on-device snapshot,
         # so never hold more than one in flight + one queued — when the
         # writer lags the step loop (epochs shorter than writes), the
@@ -118,7 +134,7 @@ class Checkpointer:
             if self._obs is not None:
                 self._obs.registry.counter("ckpt_wait_s").inc(
                     time.perf_counter() - t0)
-        self._pending.append(self._pool.submit(fn))
+        self._pending.append(self._pool.submit(run))
 
     def _drain(self) -> None:
         """Join queued background saves, surfacing their errors."""
@@ -139,6 +155,7 @@ class Checkpointer:
     def save_state(self, step: int, payload: Dict[str, Any]) -> None:
         if not self.cfg.save_last:
             return
+        flightrec.record("ckpt", f"dispatch state step={step}")
         with self._span("tpunet/ckpt_dispatch"):
             snap = _snapshot(payload)
         if self._obs is not None:
@@ -203,8 +220,25 @@ class Checkpointer:
             logging.getLogger(__name__).warning(
                 "checkpoint metadata probe failed (restoring with the "
                 "full target): %s", e)
-        return self.manager.restore(
+        restored = self.manager.restore(
             step, args=ocp.args.StandardRestore(target))
+        # Re-materialize every restored array as an XLA-owned copy
+        # (one transient duplicate, freed immediately). ROOT CAUSE of
+        # the long-open resume heap corruption (ROADMAP bug, flight-
+        # recorder A/B in runs/flightrec-repro-r7): arrays coming out
+        # of the orbax/tensorstore restore can alias IO-path host
+        # buffers, and the trainer DONATES the state to its first
+        # step (donate_argnums=0) — XLA then frees/reuses memory the
+        # IO path still owns, and glibc aborts ("corrupted
+        # double-linked list" / "free(): invalid size" / SIGSEGV) at
+        # the next allocation, right after "Starting training...".
+        # On the repro dir: 10/10 crash with donation, 4/4 clean with
+        # donation disabled, 4/4 clean with donation + this copy;
+        # fresh runs were never affected because init states are
+        # XLA-allocated from birth.
+        return jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            restored)
 
     # -- best params (reference parity) --------------------------------
 
@@ -212,6 +246,7 @@ class Checkpointer:
                   meta: Optional[Dict[str, Any]] = None) -> None:
         if not self.cfg.save_best:
             return
+        flightrec.record("ckpt", "dispatch best")
         with self._span("tpunet/ckpt_dispatch"):
             snap = _snapshot(payload)
         if self._obs is not None:
@@ -296,11 +331,13 @@ class Checkpointer:
         """Block until async writes are durable (end of run)."""
         import time
         t0 = time.perf_counter()
+        flightrec.record("ckpt", "wait begin")
         with self._span("tpunet/ckpt_wait"):
             self._drain()
             if self._mgr is not None:
                 self._mgr.wait_until_finished()
             self._best.wait_until_finished()
+        flightrec.record("ckpt", "wait end")
         if self._obs is not None:
             self._obs.registry.counter("ckpt_wait_s").inc(
                 time.perf_counter() - t0)

@@ -364,6 +364,16 @@ class ObsConfig:
     # "NAME < N", or "NAME + N/s" (growth rate). Fired rules emit
     # gauge_predicate obs_alerts (--obs-rule, repeatable).
     gauge_rules: Tuple[str, ...] = ()
+    # -- flight recorder (tpunet/obs/flightrec/) --------------------
+    # Always-on black box: a crash-durable mmap ring of recent
+    # structured events, faulthandler + native SIGSEGV/SIGABRT/SIGBUS
+    # hooks, the host-thread registry, and a post-mortem watcher that
+    # materializes <checkpoint-dir>/flightrec/crash_report.json when
+    # the process dies uncleanly. Near-zero cost (~1-2 us per event,
+    # no syscalls on the step path); --no-flightrec disables.
+    flightrec: bool = True
+    # Event-ring capacity (slots; the file is ~120 bytes per slot).
+    flightrec_events: int = 1024
     export: ExportConfig = field(default_factory=ExportConfig)
 
 
@@ -634,6 +644,17 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--obs-step-every", type=int, default=None,
                    help="emit a per-step obs_step record every N "
                         "steps (0 = per-epoch obs records only)")
+    p.add_argument("--flightrec", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="black-box flight recorder (default on): "
+                        "crash-durable event ring + crash handlers "
+                        "that leave <checkpoint-dir>/flightrec/"
+                        "crash_report.json (ring tail, per-thread "
+                        "stacks, native batcher journal) when the "
+                        "process dies; render with "
+                        "scripts/obs_crash_report.py")
+    p.add_argument("--flightrec-events", type=int, default=None,
+                   help="flight-recorder event-ring capacity (slots)")
     p.add_argument("--obs-hbm-attrib", action="store_true",
                    help="decompose the compiled train step's HBM "
                         "bytes by op category into the "
@@ -733,6 +754,11 @@ def config_from_args(argv=None) -> TrainConfig:
         obs = dataclasses.replace(obs, step_records_every=args.obs_step_every)
     if args.obs_hbm_attrib:
         obs = dataclasses.replace(obs, hbm_attrib=True)
+    if args.flightrec is not None:
+        obs = dataclasses.replace(obs, flightrec=args.flightrec)
+    if args.flightrec_events is not None:
+        obs = dataclasses.replace(obs,
+                                  flightrec_events=args.flightrec_events)
     if args.profile_start_step is not None:
         obs = dataclasses.replace(obs,
                                   profile_start_step=args.profile_start_step)

@@ -32,7 +32,8 @@ _load_failed = False
 
 
 # Must match tn_abi_version() in cxx/batcher.cc; bump both together.
-_ABI_VERSION = 1
+# v2: flight-recorder surface (tn_journal_read / tn_crash_install).
+_ABI_VERSION = 2
 
 
 def _build() -> bool:
@@ -107,12 +108,56 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tn_prefetcher_next.restype = ctypes.c_int
         lib.tn_prefetcher_destroy.argtypes = [ctypes.c_void_p]
         lib.tn_prefetcher_destroy.restype = None
+        lib.tn_journal_read.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tn_journal_read.restype = ctypes.c_int
+        lib.tn_crash_install.argtypes = [ctypes.c_char_p]
+        lib.tn_crash_install.restype = ctypes.c_int
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder surface (tpunet/obs/flightrec/): the native op
+# journal and the C-level crash spill.
+# ---------------------------------------------------------------------------
+
+
+class _JournalEntry(ctypes.Structure):
+    # Mirrors JournalEntry in cxx/batcher.cc (packed 32 bytes).
+    _fields_ = [("seq", ctypes.c_uint64), ("op", ctypes.c_uint32),
+                ("tid", ctypes.c_uint32), ("a", ctypes.c_int64),
+                ("b", ctypes.c_int64)]
+
+
+def journal_entries(max_entries: int = 256) -> list:
+    """Live snapshot of the native op journal (oldest-first dicts with
+    op names), or [] when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return []
+    buf = (_JournalEntry * max_entries)()
+    n = lib.tn_journal_read(buf, max_entries)
+    from tpunet.obs.flightrec.report import NATIVE_OPS
+    return [{"seq": int(e.seq),
+             "op": NATIVE_OPS.get(int(e.op), f"op{int(e.op)}"),
+             "tid": int(e.tid), "a": int(e.a), "b": int(e.b)}
+            for e in buf[:max(0, n)]]
+
+
+def crash_install(path: str) -> bool:
+    """Arm the C crash handler: on SIGSEGV/SIGABRT/SIGBUS it spills
+    the op journal to ``path`` and chains to the previously installed
+    handler (call AFTER faulthandler.enable so Python stacks still
+    dump). False when the library is unavailable or sigaction
+    failed."""
+    lib = _load()
+    if lib is None:
+        return False
+    return lib.tn_crash_install(os.fsencode(path)) == 0
 
 
 def _as_u8p(a: np.ndarray):
@@ -175,11 +220,27 @@ class NativePrefetcher:
             _as_u8p(self.rows), _as_i32p(self.labels), len(self.rows),
             row_bytes, self.local_batch, depth, n_threads)
         self._idx: Optional[np.ndarray] = None   # keep alive for C++ reads
+        # Host-thread registry (tpunet/obs/flightrec/): the C++ worker
+        # cannot beat from its own thread, so the consumer side beats
+        # for it — a beat marks "about to block in next()" (busy), and
+        # a consumer stuck there past the budget is exactly the hang
+        # the thread_stalled alert should page (the C journal then
+        # says what the worker was doing). Lazy import: this module
+        # must stay importable without the obs stack.
+        try:
+            from tpunet.obs import flightrec
+            self._fr = flightrec
+            self._thread = flightrec.register_thread(
+                "native-prefetcher", stall_after_s=120.0)
+        except Exception:
+            self._fr = self._thread = None
 
     def iter_epoch(self, idx: np.ndarray) -> Iterator[
             Tuple[np.ndarray, np.ndarray]]:
         """Yield (rows[local_batch, ...], labels) following ``idx``."""
         self._idx = np.ascontiguousarray(idx, dtype=np.int64)
+        if self._fr is not None:
+            self._fr.record("prefetch", f"epoch start n={len(idx)}")
         if self._lib.tn_prefetcher_start_epoch(
                 self._handle, _as_i64p(self._idx), len(self._idx)):
             raise IndexError("prefetcher index out of range for dataset")
@@ -187,13 +248,23 @@ class NativePrefetcher:
             x = np.empty((self.local_batch,) + self.row_shape,
                          self.row_dtype)
             y = np.empty((self.local_batch,), np.int32)
+            if self._thread is not None:
+                self._thread.beat("busy")    # about to block in next()
             if self._lib.tn_prefetcher_next(self._handle, _as_u8p(x),
                                             _as_i32p(y)):
+                if self._thread is not None:
+                    self._thread.beat("idle")
+                if self._fr is not None:
+                    self._fr.record("prefetch", "epoch exhausted")
                 return
+            if self._thread is not None:
+                self._thread.beat("idle")
             yield x, y
 
     def close(self) -> None:
         if self._handle:
+            if self._fr is not None:
+                self._fr.record("prefetch", "destroy")
             self._lib.tn_prefetcher_destroy(self._handle)
             self._handle = None
 

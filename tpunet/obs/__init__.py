@@ -17,8 +17,14 @@ One ``Observability`` object per run orchestrates the pieces:
   the step path one ``put_nowait``, never a stall; overflow drops are
   counted, never silent.
 - ``health``     — run-health watchdog over the same record stream:
-  step stalls, NaN/spiking loss, stale heartbeats -> ``obs_alert``
-  records, optionally aborting the run (``--halt-on-unhealthy``).
+  step stalls, NaN/spiking loss, stale heartbeats, stalled host
+  threads -> ``obs_alert`` records, optionally aborting the run
+  (``--halt-on-unhealthy``).
+- ``flightrec``  — black-box flight recorder (default ON): crash-
+  durable mmap event ring, faulthandler + native signal hooks, the
+  host-thread registry, and a watcher process that assembles
+  ``flightrec/crash_report.json`` when the run dies (README "Crash
+  forensics").
 - ``summary``    — the one summarizer ``scripts/obs_report.py`` and
   ``scripts/obs_dashboard.py`` share.
 
@@ -55,6 +61,28 @@ __all__ = [
 ]
 
 
+class _RecordedSpan:
+    """A trace span that also drops begin/end events into the flight
+    recorder's ring — the crash tail's "which phase were we in".
+    One object + two ring writes per span (~2-3 us); only built when
+    a recorder is armed."""
+
+    __slots__ = ("_inner", "_name", "_rec")
+
+    def __init__(self, inner, name: str, rec):
+        self._inner = inner
+        self._name = name
+        self._rec = rec
+
+    def __enter__(self):
+        self._rec.record("span", self._name)
+        return self._inner.__enter__()
+
+    def __exit__(self, *exc):
+        self._rec.record("span_end", self._name)
+        return self._inner.__exit__(*exc)
+
+
 class Observability:
     """Run-scoped observability facade the trainer threads through.
 
@@ -72,6 +100,12 @@ class Observability:
         if cfg.step_records_every < 0:
             raise ValueError(f"obs.step_records_every must be >= 0, "
                              f"got {cfg.step_records_every}")
+        if getattr(cfg, "flightrec", False) \
+                and getattr(cfg, "flightrec_events", 1) < 1:
+            raise ValueError(
+                f"obs.flightrec_events must be >= 1 when the flight "
+                f"recorder is enabled, got {cfg.flightrec_events} "
+                "(use --no-flightrec to disable the recorder)")
         self.enabled = bool(cfg.enabled)
         self.unit = unit
         self.step_records_every = cfg.step_records_every
@@ -92,6 +126,30 @@ class Observability:
                 run_id=getattr(cfg, "run_id", ""),
                 directory=checkpoint_dir, resume=resume,
                 process_index=pidx, persist=(pidx == 0)))
+        # Black-box flight recorder (tpunet/obs/flightrec/): event
+        # ring + crash handlers + host-thread registry, default ON.
+        # Prior-crash detection runs FIRST: if the previous
+        # incarnation of this run dir died and left a crash report,
+        # it is archived now and emitted as ONE obs_crash record at
+        # the first epoch (once the jsonl sink is attached).
+        self.flightrec = None
+        self._pending_crash = None
+        if self.enabled and getattr(cfg, "flightrec", False):
+            from tpunet.obs import flightrec
+            rep, report_path = flightrec.prior_crash_report(
+                checkpoint_dir, pidx)
+            if rep is not None:
+                self._pending_crash = flightrec.crash_record(
+                    rep, report_path)
+            self.flightrec = flightrec.install(
+                checkpoint_dir, process_index=pidx,
+                n_events=getattr(cfg, "flightrec_events", 1024),
+                run_id=str(self.registry.identity().get("run_id", "")))
+            try:
+                self.flightrec.set_device_memory(
+                    obs_memory.sample_memory_gauges(self.registry))
+            except Exception:
+                pass
         # Run-health watchdog: consumes the same host-side laps/losses
         # this facade already sees, emits obs_alert records through
         # the registry (so they reach metrics.jsonl and every live
@@ -160,10 +218,21 @@ class Observability:
     # -- spans ----------------------------------------------------------
 
     def span(self, name: str):
-        return span(name) if self.hot else NULL_SPAN
+        if not self.hot:
+            return NULL_SPAN
+        if self.flightrec is not None:
+            # Span begin/end also lands in the flight-recorder ring:
+            # on a crash, the tail says which phase the run died in.
+            return _RecordedSpan(span(name), name, self.flightrec)
+        return span(name)
 
     def step_span(self, step: int):
-        return step_span(step) if self.hot else NULL_SPAN
+        if not self.hot:
+            return NULL_SPAN
+        if self.flightrec is not None:
+            return _RecordedSpan(step_span(step), f"step {step}",
+                                 self.flightrec)
+        return step_span(step)
 
     # -- per-step hooks (called only when ``hot``) ----------------------
 
@@ -214,6 +283,17 @@ class Observability:
     def begin_epoch(self, epoch: int) -> None:
         if not self.enabled:
             return
+        if self._pending_crash is not None:
+            # The previous incarnation of this run dir crashed and the
+            # watcher left a report: emit it exactly once, now that
+            # the trainer has attached the jsonl sink — the record
+            # reaches metrics.jsonl, live exporters, and (through
+            # them) the fleet aggregator's crash alert.
+            record, self._pending_crash = self._pending_crash, None
+            self.registry.counter("obs_crashes").inc()
+            self.registry.emit("obs_crash", record)
+        if self.flightrec is not None:
+            self.flightrec.record("epoch", f"begin {epoch}")
         self.registry.reset_window()
 
     def end_epoch(self, *, epoch: int, step: int, units: float,
@@ -234,6 +314,17 @@ class Observability:
         mem = obs_memory.sample_memory_gauges(reg)
         live = obs_memory.heartbeat(
             reg, time.perf_counter() - self._run_start)
+        # Host-thread registry -> thread_* gauges (exporters and
+        # --obs-rule predicates see them), and the flight recorder's
+        # last-known device-memory / thread snapshots refresh so a
+        # crash report carries this epoch's state, not the install's.
+        from tpunet.obs.flightrec.threads import THREADS
+        THREADS.export_gauges(reg)
+        if self.flightrec is not None:
+            self.flightrec.set_device_memory(mem)
+            self.flightrec.refresh_threads()
+        if self.watchdog is not None:
+            self.watchdog.check_threads(step)
         if self.watchdog is not None:
             # Feed the liveness result BEFORE emitting the epoch
             # record: a missing_processes alert then precedes the
@@ -305,3 +396,11 @@ class Observability:
                 except Exception:
                     pass
             self._exporters = []
+            if self.flightrec is not None:
+                # Clean shutdown: the watcher must not assemble a
+                # crash report for this incarnation. Only closes the
+                # global recorder if it is still ours (a newer
+                # Observability may have re-armed it).
+                from tpunet.obs import flightrec
+                flightrec.close(self.flightrec)
+                self.flightrec = None

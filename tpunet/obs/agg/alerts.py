@@ -44,6 +44,12 @@ class AlertBridge:
             GaugePredicate.parse(spec)
         self._rule_insts: Dict[tuple, GaugePredicate] = {}
         self._latched: set = set()
+        # Crash alerts are count-edge-triggered, not latched: every
+        # rollup that sees a stream's obs_crash count advance pages
+        # once, with the cumulative count in the detail — a
+        # crash-looping replica keeps paging instead of latching
+        # silent after its first crash.
+        self._crash_seen: Dict[str, int] = {}
         self.alerts: List[dict] = []
 
     # -- emission --------------------------------------------------------
@@ -76,12 +82,33 @@ class AlertBridge:
         fired by THIS call (all alerts accumulate in ``self.alerts``
         and in the registry's sinks)."""
         fired_before = len(self.alerts)
+        self._check_crashes(streams)
         self._check_straggler(rollup)
         self._check_mem_growth(streams)
         if now is not None and self.stream_stale_s > 0:
             self._check_stale(streams, now)
         self._check_rules(rollup, streams, now)
         return self.alerts[fired_before:]
+
+    def _check_crashes(self, streams) -> None:
+        """A stream whose ``obs_crash`` count advanced since the last
+        rollup crashed (and restarted) in between: page once per such
+        rollup, carrying the cumulative count and the latest crash
+        summary the restarted run emitted (tpunet/obs/flightrec/)."""
+        for s in streams:
+            seen = self._crash_seen.get(s.key, 0)
+            if s.crashes <= seen:
+                continue
+            self._crash_seen[s.key] = s.crashes
+            detail = {"count": s.crashes}
+            last = s.last_crash or {}
+            for field in ("cause", "signal", "report_path"):
+                if last.get(field) is not None:
+                    detail[field] = last[field]
+            # Bypass the latch: the count edge IS the dedup.
+            key = ("crash", s.key, s.crashes)
+            self._fire("crash", scope="stream", stream=s.key,
+                       detail=detail, latch_key=key)
 
     def _check_straggler(self, rollup: dict) -> None:
         factor = rollup.get("straggler_factor")

@@ -114,6 +114,28 @@ class Aggregator:
             return fleet_rollup(sorted(self._streams.values(),
                                        key=lambda s: s.key))
 
+    def recent_alerts(self) -> List[dict]:
+        """Recently ingested per-run ``obs_alert`` records (bounded
+        per stream), each tagged with its stream key — the fleet
+        panels surface per-run pages (thread_stalled, step_stall,
+        ...) and crash records alongside the bridge's own fleet
+        alerts."""
+        out: List[dict] = []
+        for s in self.streams():
+            for a in list(s.recent_alerts):
+                row = dict(a)
+                row.setdefault("scope", "run")
+                row.setdefault("stream", s.key)
+                out.append(row)
+            if s.last_crash is not None:
+                row = {"reason": "crash", "scope": "run",
+                       "stream": s.key, "severity": "fatal"}
+                for field in ("cause", "signal", "report_path"):
+                    if s.last_crash.get(field) is not None:
+                        row[field] = s.last_crash[field]
+                out.append(row)
+        return out
+
     def heartbeat_ages(self) -> Dict[str, float]:
         """Seconds since each stream's last record arrived (live mode
         only — replayed streams have no arrival clock)."""

@@ -35,6 +35,12 @@ class StreamState:
         self.identity: Dict[str, object] = {}
         self.records = 0
         self.alerts = 0
+        # Recent per-run alert/crash records (dashboard fleet panels
+        # show WHAT paged, not just a count); bounded like everything
+        # else here.
+        self.recent_alerts: deque = deque(maxlen=8)
+        self.crashes = 0
+        self.last_crash: Optional[dict] = None
         self.last_seen: Optional[float] = None  # receiver clock; live only
         # Training-side digest.
         self.last_epoch: Optional[dict] = None
@@ -83,6 +89,13 @@ class StreamState:
             self.serve_records += 1
         elif kind == "obs_alert":
             self.alerts += 1
+            self.recent_alerts.append(record)
+        elif kind == "obs_crash":
+            # A restarted run reporting its previous incarnation's
+            # death (tpunet/obs/flightrec/): tracked per stream so the
+            # fleet view can say which replica is crash-looping.
+            self.crashes += 1
+            self.last_crash = record
 
     # -- derived ---------------------------------------------------------
 
@@ -161,6 +174,9 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
         "records_total": sum(s.records for s in streams),
         "alerts_total": sum(s.alerts for s in streams),
     }
+    crashes = sum(s.crashes for s in streams)
+    if crashes:
+        out["crashes_total"] = crashes
     per_stream: List[dict] = []
 
     # -- training rollup -------------------------------------------------
@@ -260,6 +276,8 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
     for s in streams:
         row: dict = {"stream": s.key, "records": s.records,
                      "alerts": s.alerts}
+        if s.crashes:
+            row["crashes"] = s.crashes
         row.update(s.identity)
         if s.last_epoch is not None:
             row["epoch"] = s.last_epoch.get("epoch")

@@ -94,6 +94,13 @@ class AsyncExporter:
             self._dropped = Counter()
             self._sent_gauge = Gauge()
             self._err_gauge = Gauge()
+        # Host-thread registry (tpunet/obs/flightrec/): the drain
+        # thread flips idle (parked on the queue) / busy (sending), so
+        # thread_stalled only pages on a send wedged past the budget,
+        # never on an idle exporter.
+        from tpunet.obs.flightrec import register_thread
+        self._handle = register_thread(f"export-{name}",
+                                       stall_after_s=120.0)
         self._thread = threading.Thread(
             target=self._drain, name=f"tpunet-export-{name}", daemon=True)
         self._thread.start()
@@ -156,8 +163,11 @@ class AsyncExporter:
 
     def _drain(self) -> None:
         while True:
+            self._handle.beat("idle")
             item = self._q.get()
+            self._handle.beat("busy")
             if item is _CLOSE:
+                self._handle.beat("idle")
                 return
             batch = [item]
             stop = False
@@ -194,4 +204,5 @@ class AsyncExporter:
                             self._errors += len(batch)
                     self._err_gauge.set(self._errors)
             if stop:
+                self._handle.beat("idle")
                 return

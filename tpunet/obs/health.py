@@ -15,6 +15,12 @@ the ways that actually burn walltime:
 - **stale heartbeat / missing processes**: no heartbeat inside
   ``heartbeat_timeout_s`` (a wedged epoch), or an epoch heartbeat
   counting fewer live processes than the pod started with.
+- **thread stalled**: a background thread registered in the host-
+  thread registry (``tpunet/obs/flightrec/threads.py`` — orbax async
+  writer, exporter drain, native prefetcher, serve engine) has been
+  ``busy`` past its declared stall budget — per-thread attribution
+  for "the host runtime is wedged", with per-thread cooldown keys so
+  two stalled threads are two pages.
 
 Alerts are per-reason rate-limited (``alert_cooldown_steps``) so a
 stalled input pipeline pages once, not once per step; suppressed
@@ -173,6 +179,9 @@ class Watchdog:
     # Loss-EMA warmup before spike verdicts, and its decay.
     MIN_LOSS_OBS = 5
     LOSS_EMA_DECAY = 0.9
+    # Host-thread stall checks piggyback every Nth step (plus the
+    # monitor loop and epoch boundaries).
+    THREAD_CHECK_STEPS = 16
 
     def __init__(self, cfg, registry, *, expected_processes: int = 1,
                  clock=time.monotonic):
@@ -226,6 +235,11 @@ class Watchdog:
         self._last_progress = self._clock()
         self._last_step = step
         self.check_heartbeat(step=step)
+        if step % self.THREAD_CHECK_STEPS == 0:
+            # Cheap but not free (a lock + list copy in the registry),
+            # so piggyback every Nth step; the monitor thread and the
+            # epoch boundary also check, covering wedged-loop cases.
+            self.check_threads(step)
 
     def observe_loss(self, step: int, loss: float) -> None:
         """A host-available loss value (the per-step log line or the
@@ -271,6 +285,22 @@ class Watchdog:
             self._alert("stale_heartbeat", step, fatal=False, detail={
                 "age_s": round(age, 2), "timeout_s": timeout})
 
+    def check_threads(self, step: int = 0) -> None:
+        """``thread_stalled``: a registered host thread
+        (tpunet/obs/flightrec/threads.py) past its declared stall
+        budget while marked busy. Non-fatal — a stalled writer thread
+        is a page, not automatically a dead run — and cooldown-keyed
+        per thread, so the orbax writer stalling and the exporter
+        stalling in the same window are two distinct pages."""
+        from tpunet.obs.flightrec.threads import THREADS
+        for handle, age in THREADS.stalled():
+            self._alert("thread_stalled", step, fatal=False, detail={
+                "thread": handle.name,
+                "age_s": round(age, 2),
+                "stall_after_s": handle.stall_after_s,
+                "state": handle.state,
+            }, cooldown_key=f"thread_stalled:{handle.name}")
+
     def check_gauges(self, step: int, snapshot: dict) -> None:
         """Evaluate every configured ``GaugePredicate`` against a
         registry snapshot (the epoch-boundary hook — the same flat
@@ -315,9 +345,16 @@ class Watchdog:
         self._monitor = None
 
     def _monitor_loop(self) -> None:
+        from tpunet.obs.flightrec import register_thread
+        handle = register_thread("watchdog-monitor")
         timeout = self.cfg.heartbeat_timeout_s
         poll = min(max(timeout / 4.0, 0.5), 5.0)
         while not self._stop_monitor.wait(poll):
+            handle.beat()
+            # Thread stalls are checkable even while the training
+            # thread is wedged inside a step — that is this thread's
+            # whole reason to exist.
+            self.check_threads(self._last_step)
             age = self._clock() - max(self._last_beat,
                                       self._last_progress)
             if age > timeout:
@@ -333,6 +370,11 @@ class Watchdog:
 
     def _alert(self, reason: str, step: int, *, fatal: bool,
                detail: dict, cooldown_key: str = "") -> None:
+        # Every detection lands in the flight-recorder ring (raw
+        # forensic signal, a ring cannot be flooded); the page feed
+        # below still honors the cooldown.
+        from tpunet.obs import flightrec
+        flightrec.record("alert", f"{reason} step={step}")
         key = cooldown_key or reason
         last = self._last_alert_step.get(key)
         cooldown = self.cfg.alert_cooldown_steps

@@ -62,9 +62,17 @@ def summarize(records: List[dict], n_windows: int = 12) -> Dict:
     obs = [r for r in records if r.get("kind") == "obs_epoch"]
     steps = [r for r in records if r.get("kind") == "obs_step"]
     alerts = [r for r in records if r.get("kind") == "obs_alert"]
+    # obs_crash records (a restarted run reporting its predecessor's
+    # death, tpunet/obs/flightrec/) surface in the alert feed: a crash
+    # is the page of pages. They keep their own count in totals.
+    crashes = [r for r in records if r.get("kind") == "obs_crash"]
+    alerts = alerts + [{**r, "reason": "crash", "severity": "fatal",
+                        "step": r.get("step", 0)} for r in crashes]
 
     totals: Dict = {"epochs": len(epochs), "obs_epochs": len(obs),
                     "obs_steps": len(steps), "alerts": len(alerts)}
+    if crashes:
+        totals["crashes"] = len(crashes)
     if obs:
         stall = sum(r.get("input_stall_s", 0.0) for r in obs)
         train = sum(r.get("train_seconds", 0.0) for r in obs)

@@ -139,6 +139,15 @@ def build_server(args):
     metrics_logger = None
     exporters = []
     metrics_dir = args.metrics_dir or args.checkpoint_dir
+    # Black-box flight recorder for the SERVING process (README
+    # "Crash forensics"): event ring + crash handlers + watcher into
+    # <metrics-dir>/flightrec, so a dead replica leaves a
+    # crash_report.json next to its metrics. Same default-ON as the
+    # trainer; the engine/frontend record() calls land here.
+    recorder = None
+    if metrics_dir:
+        from tpunet.obs import flightrec
+        recorder = flightrec.install(metrics_dir, run_id=args.run_id)
     if metrics_dir:
         metrics_logger = MetricsLogger(metrics_dir, resume=True)
         registry.add_sink(JsonlSink(metrics_logger))
@@ -166,7 +175,8 @@ def build_server(args):
     return ServeServer(engine, classify_batcher=batcher,
                        host=cfg.host, port=cfg.port,
                        metrics_logger=metrics_logger,
-                       exporters=exporters, run_id=cfg.run_id)
+                       exporters=exporters, run_id=cfg.run_id,
+                       flight_recorder=recorder)
 
 
 def main(argv=None) -> int:

@@ -169,6 +169,7 @@ class Engine:
         self._drain_kill = threading.Event()
         self._drained = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._thread_handle = None       # flightrec registry handle
         self.error: Optional[str] = None
         self._last_emit = time.perf_counter()
         self._started = time.perf_counter()
@@ -217,6 +218,13 @@ class Engine:
     # -- public API ------------------------------------------------------
 
     def start(self) -> "Engine":
+        # Host-thread registry (tpunet/obs/flightrec/): a decode
+        # iteration wedged on the device past the budget pages
+        # thread_stalled; idle waits (empty pool) do not.
+        from tpunet.obs import flightrec
+        self._thread_handle = flightrec.register_thread(
+            "serve-engine", stall_after_s=120.0)
+        flightrec.record("serve", f"engine start slots={self.slots}")
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="tpunet-serve-engine")
         self._thread.start()
@@ -346,20 +354,26 @@ class Engine:
     # -- engine loop -----------------------------------------------------
 
     def _run(self) -> None:
+        from tpunet.obs import flightrec
+        handle = self._thread_handle
         try:
             while not self._stop.is_set():
+                handle.beat("busy")
                 did_work = self._iterate()
                 if self._draining.is_set() and self.active_slots() == 0 \
                         and self.queue.depth() == 0:
                     break
                 if not did_work:
+                    handle.beat("idle")
                     self._wake.wait(timeout=0.02)
                     self._wake.clear()
+            handle.beat("idle")
             self._emit_record(final=True)
         except BaseException as e:  # noqa: BLE001 — engine death is a
             # liveness event: surface through /healthz and fail every
             # request fast rather than hanging clients.
             self.error = f"{type(e).__name__}: {e}"
+            flightrec.record("serve", f"engine error: {e}")
             for slot in self._active:
                 if slot is not None:
                     slot.req.finish(FINISH_ERROR, error=self.error)
@@ -542,5 +556,10 @@ class Engine:
             reg, queue_depth=self.queue.depth(),
             active_slots=self.active_slots(), slots=self.slots,
             uptime_s=now - self._started, window_s=window, final=final)
+        # Host-thread gauges ride the serve registry too: GET /metrics
+        # and exporters see thread_* ages for the engine loop and any
+        # exporter drains.
+        from tpunet.obs.flightrec.threads import THREADS
+        THREADS.export_gauges(reg)
         reg.emit("obs_serve", record)
         reg.reset_window()

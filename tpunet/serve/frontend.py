@@ -54,7 +54,8 @@ class ServeServer:
 
     def __init__(self, engine: Engine, *, classify_batcher=None,
                  host: str = "127.0.0.1", port: int = 8000,
-                 metrics_logger=None, exporters=(), run_id: str = ""):
+                 metrics_logger=None, exporters=(), run_id: str = "",
+                 flight_recorder=None):
         self.engine = engine
         self.classify = classify_batcher
         self.registry = engine.registry
@@ -73,6 +74,10 @@ class ServeServer:
         self.vocab_size = int(engine.model.vocab_size)
         self._metrics_logger = metrics_logger
         self._exporters = list(exporters)
+        # Flight recorder owned by this server's process (installed by
+        # the serve entry when a metrics dir exists); drain marks the
+        # clean shutdown so the watcher never fabricates a crash.
+        self._flightrec = flight_recorder
         self._drained = False
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
@@ -94,6 +99,8 @@ class ServeServer:
         if self._drained:
             return True
         self._drained = True
+        from tpunet.obs import flightrec
+        flightrec.record("serve", "frontend drain")
         ok = self.engine.drain(timeout)
         for exporter in self._exporters:
             try:
@@ -104,6 +111,9 @@ class ServeServer:
         self.httpd.server_close()
         if self.classify is not None:
             self.classify.close()
+        if self._flightrec is not None:
+            flightrec.close(self._flightrec)
+            self._flightrec = None
         return ok
 
     close = drain

@@ -23,6 +23,7 @@ from tpunet.config import TrainConfig
 from tpunet.data import (eval_batches, get_dataset, steps_per_epoch,
                          timed_batches, train_batches)
 from tpunet.obs import JsonlSink, Observability, RunUnhealthyError
+from tpunet.obs import flightrec
 from tpunet.obs.perf import train_flops_per_unit
 from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
                              shard_host_batch)
@@ -196,35 +197,19 @@ class Trainer:
             # datasets carry [B, T] segment ids in the label slot, which
             # the prefetcher's scalar-label ABI doesn't cover — numpy
             # path there.
-            if cfg.checkpoint.resume and not os.environ.get(
-                    "TPUNET_NATIVE_RESUME"):
-                # KNOWN BUG GUARD (ROADMAP): --resume with the native
-                # C++ prefetcher has crashed with glibc heap corruption
-                # ("corrupted double-linked list" / SIGSEGV) right
-                # after "Starting training..." on a single-core CPU
-                # host (PR-3 tree; fresh runs and --no-native-loader
-                # resumes are fine, and the restore itself completes).
-                # Audit of tpunet/data/native.py + cxx/batcher.cc found
-                # no shutdown/re-init lifetime hole (start_epoch joins
-                # the old worker before rebinding state; the epoch
-                # index is copied host-side before the call returns;
-                # Python keeps the zero-copy row/label arrays alive for
-                # the prefetcher's lifetime), and the crash does not
-                # reproduce on this tree — but until it is root-caused,
-                # a resumed run gets the numpy loader instead of a
-                # possible SIGSEGV. TPUNET_NATIVE_RESUME=1 opts back
-                # in (e.g. to bisect on the affected host).
-                log0("WARNING: --resume currently falls back to the "
-                     "numpy host loader (known native-prefetcher heap "
-                     "corruption on resume, see ROADMAP); set "
-                     "TPUNET_NATIVE_RESUME=1 to force the native path")
-            else:
-                from tpunet.data import native
-                if native.available():
-                    local = cfg.data.batch_size // jax.process_count()
-                    self._prefetcher = native.NativePrefetcher(
-                        self.train_x, self.train_y.astype(np.int32),
-                        local)
+            # The long-standing resume heap-corruption bug that used
+            # to force a numpy-loader fallback here was root-caused
+            # (flight-recorder evidence, runs/flightrec-repro-r7) to
+            # buffer donation of orbax-restored state — nothing to do
+            # with the prefetcher — and fixed at the source
+            # (Checkpointer.restore_state re-materializes restored
+            # arrays), so resumed runs keep the native path.
+            from tpunet.data import native
+            if native.available():
+                local = cfg.data.batch_size // jax.process_count()
+                self._prefetcher = native.NativePrefetcher(
+                    self.train_x, self.train_y.astype(np.int32),
+                    local)
 
         self._schedule = lr_schedule(cfg.optim, self.spe, cfg.epochs)
         # Observability (tpunet/obs/): per-step timing + stall split +
@@ -319,6 +304,9 @@ class Trainer:
         self.start_epoch = int(restored["epoch"]) + (1 if completed else 0)
         self.global_step = int(restored["global_step"])
         self.best_acc = float(restored["best_acc"])
+        flightrec.record("train", f"resume restored epoch="
+                                  f"{int(restored['epoch'])} "
+                                  f"step={self.global_step}")
         log0(f"Resumed from epoch {int(restored['epoch'])}"
              f"{'' if completed else ' (partial)'} "
              f"(best acc {self.best_acc:.4f})")
@@ -525,6 +513,9 @@ class Trainer:
         log0("Host loader: " + ("native C++ prefetcher"
                                 if self._prefetcher is not None else "numpy"))
         log0("Starting training...")
+        flightrec.record("train", "starting training loader="
+                         + ("native" if self._prefetcher is not None
+                            else "numpy"))
         log0("")
         metrics_log = MetricsLogger(cfg.checkpoint.directory,
                                     resume=cfg.checkpoint.resume)
@@ -568,6 +559,8 @@ class Trainer:
                     # Preempted mid-epoch: persist the advanced state,
                     # marked partial so --resume re-runs this epoch's
                     # remaining data instead of skipping it.
+                    flightrec.record("train", f"preemption epoch="
+                                              f"{epoch}")
                     log0(f"Preemption requested at epoch {epoch} (step "
                          f"{self.global_step}); "
                          + ("saving state and exiting"
