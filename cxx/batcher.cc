@@ -61,6 +61,9 @@ enum JournalOp : uint32_t {
   kJopGather = 13,
 };
 
+// Snapshot/output layout (plain POD, 32 bytes packed): the C ABI for
+// tn_journal_read and the crash spill, mirrored by ctypes in
+// tpunet/data/native.py. Unchanged since ABI v2.
 struct JournalEntry {
   uint64_t seq;
   uint32_t op;
@@ -69,8 +72,27 @@ struct JournalEntry {
   int64_t b;
 };
 
+// Ring storage: a per-slot seqlock. The original ring wrote plain
+// fields "racy by design" (seq stored last, readers drop mismatched
+// slots) — which worked on x86 but was a formal C++ data race, and
+// the first TSan build of this file said so (scripts/
+// check_sanitizers.py). Same protocol, now through atomics: writers
+// invalidate seq, fill fields relaxed, publish seq with a release
+// store; readers acquire-load seq before AND after copying the
+// fields and drop the slot on any mismatch. Relaxed/acq-rel atomics
+// compile to the same plain MOVs here, so the journal stays ~one
+// fetch_add per op — lock-free and async-signal-safe (all five
+// atomics are lock-free at these sizes on every supported target).
+struct JournalSlot {
+  std::atomic<uint64_t> seq{0};
+  std::atomic<uint32_t> op{0};
+  std::atomic<uint32_t> tid{0};
+  std::atomic<int64_t> a{0};
+  std::atomic<int64_t> b{0};
+};
+
 constexpr uint64_t kJournalSlots = 256;
-JournalEntry g_journal[kJournalSlots];
+JournalSlot g_journal[kJournalSlots];
 std::atomic<uint64_t> g_journal_seq{0};
 
 uint32_t journal_tid() {
@@ -82,16 +104,20 @@ uint32_t journal_tid() {
 void journal(JournalOp op, int64_t a = 0, int64_t b = 0) {
   const uint64_t seq =
       g_journal_seq.fetch_add(1, std::memory_order_relaxed) + 1;
-  JournalEntry& e = g_journal[(seq - 1) % kJournalSlots];
-  // Racy by design (a reader may see a torn slot during the write);
-  // seq is stored last so readers can drop slots whose seq doesn't
-  // match the position they expected.
-  e.seq = 0;
-  e.op = op;
-  e.tid = journal_tid();
-  e.a = a;
-  e.b = b;
-  e.seq = seq;
+  JournalSlot& e = g_journal[(seq - 1) % kJournalSlots];
+  // Seqlock write: invalidate, fill, publish. The release FENCE after
+  // the invalidation is load-bearing on weakly ordered targets: a
+  // release *store* only orders PRIOR accesses, so without the fence
+  // the relaxed field stores could hoist above seq=0 and a reader
+  // could pass both checks on a torn slot. The final release store
+  // orders the field stores before the publish.
+  e.seq.store(0, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  e.op.store(op, std::memory_order_relaxed);
+  e.tid.store(journal_tid(), std::memory_order_relaxed);
+  e.a.store(a, std::memory_order_relaxed);
+  e.b.store(b, std::memory_order_relaxed);
+  e.seq.store(seq, std::memory_order_release);
 }
 
 int journal_snapshot(JournalEntry* out, int max_entries) {
@@ -99,8 +125,23 @@ int journal_snapshot(JournalEntry* out, int max_entries) {
   const uint64_t span = cur < kJournalSlots ? cur : kJournalSlots;
   int n = 0;
   for (uint64_t s = cur - span + 1; s <= cur && n < max_entries; ++s) {
-    const JournalEntry e = g_journal[(s - 1) % kJournalSlots];
-    if (e.seq != s) continue;  // torn or already lapped
+    const JournalSlot& slot = g_journal[(s - 1) % kJournalSlots];
+    // Seqlock read: validate seq on both sides of the field copy — a
+    // writer racing us flips seq to 0 first, so any torn copy fails
+    // one of the two checks and the slot is dropped, exactly the old
+    // semantics minus the undefined behavior. The leading acquire
+    // load keeps the field loads from hoisting above it; the acquire
+    // FENCE keeps them from sinking below the re-check (an acquire
+    // *load* there would only order accesses AFTER itself).
+    if (slot.seq.load(std::memory_order_acquire) != s) continue;
+    JournalEntry e;
+    e.op = slot.op.load(std::memory_order_relaxed);
+    e.tid = slot.tid.load(std::memory_order_relaxed);
+    e.a = slot.a.load(std::memory_order_relaxed);
+    e.b = slot.b.load(std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (slot.seq.load(std::memory_order_relaxed) != s) continue;
+    e.seq = s;
     out[n++] = e;
   }
   return n;

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Native-batcher stress driver — the workload the sanitizer gates run.
+
+Invoked as a subprocess by scripts/check_sanitizers.py (and the slow
+tests) with ``TPUNET_NATIVE_LIB`` pointing at a sanitizer build of
+``cxx/batcher.cc`` and the matching runtime ``LD_PRELOAD``ed. Never
+imports jax: the point is to hammer the C++ extension's concurrency
+surface (the 256-slot lock-free journal ring, worker lifecycle,
+create/stop/destroy churn) under ASan/UBSan/TSan, not to train.
+
+Scenarios (``all`` runs every one):
+
+- ``gather``   — concurrent ``gather_rows`` from 8 python threads
+  (each fanning out 4 C threads), results checked against numpy.
+- ``churn``    — create / start_epoch / consume-a-random-prefix /
+  destroy cycles, including mid-epoch destroys (the stop/join path
+  that tears down a worker holding batches).
+- ``journal``  — N prefetchers running epochs concurrently (journal
+  writers on every worker and consumer thread) while a poller thread
+  live-snapshots ``tn_journal_read`` in a tight loop — the seqlock
+  read/write race TSan exists to judge. Snapshot invariants checked:
+  parseable ops, strictly increasing seqs.
+- ``restart``  — ``start_epoch`` repeatedly on one prefetcher without
+  draining (epoch-abandon stop path), plus an out-of-range reject.
+
+Exit codes: 0 = pass, 3 = native library unavailable (a sanitizer
+gate must treat that as its own failure to set up, never as a pass),
+1 = assertion failure. A sanitizer abort surfaces as the sanitizer's
+own exit code (check_sanitizers.py sets a distinctive one).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import threading
+
+import numpy as np
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _load_native():
+    """Load tpunet/data/native.py by FILE PATH, not through the
+    package: ``tpunet.data.__init__`` imports the augment stack and
+    with it jax — which must never enter this process (the gate's
+    point is to judge cxx/batcher.cc alone, and the driver must run
+    on jax-less CI hosts)."""
+    # Hard-block the tpunet package: native.py's OPTIONAL obs imports
+    # (try/except around the flightrec registry and the journal op
+    # table) must fail fast here rather than drag jax/jaxlib into the
+    # sanitized process as uninstrumented noise.
+    sys.modules["tpunet"] = None  # type: ignore[assignment]
+    path = os.path.join(_REPO, "tpunet", "data", "native.py")
+    spec = importlib.util.spec_from_file_location("_tn_native", path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+native = _load_native()
+
+ROWS = 2048
+ROW_SHAPE = (16, 4)              # 64 bytes/row
+BATCH = 32
+
+
+def _dataset(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, 255, size=(ROWS,) + ROW_SHAPE,
+                        dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(ROWS,), dtype=np.int32)
+    return rows, labels
+
+
+def scenario_gather() -> None:
+    rows, _ = _dataset(1)
+    rng = np.random.default_rng(2)
+    errors: list = []
+
+    def worker(tid: int) -> None:
+        try:
+            local = np.random.default_rng(100 + tid)
+            for _ in range(20):
+                idx = local.integers(0, ROWS, size=512, dtype=np.int64)
+                out = native.gather_rows(rows, idx, n_threads=4)
+                if not np.array_equal(out, rows[idx]):
+                    raise AssertionError("gather mismatch")
+        except Exception as e:  # noqa: BLE001 — collected for the exit code
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    del rng
+
+
+def scenario_churn() -> None:
+    rows, labels = _dataset(3)
+    rng = np.random.default_rng(4)
+    for i in range(24):
+        pf = native.NativePrefetcher(rows, labels, BATCH, depth=3,
+                                     n_threads=2)
+        idx = rng.permutation(ROWS).astype(np.int64)
+        consume = int(rng.integers(0, ROWS // BATCH + 1))
+        for n, (x, y) in enumerate(pf.iter_epoch(idx)):
+            if n == 0:
+                if not np.array_equal(x, rows[idx[:BATCH]]):
+                    raise AssertionError("first batch mismatch")
+                if not np.array_equal(y, labels[idx[:BATCH]]):
+                    raise AssertionError("first labels mismatch")
+            if n + 1 >= consume:
+                break                      # mid-epoch abandon
+        pf.close()                         # destroy (possibly mid-flight)
+
+
+def scenario_journal() -> None:
+    rows, labels = _dataset(5)
+    stop = threading.Event()
+    errors: list = []
+
+    def poller() -> None:
+        try:
+            while not stop.is_set():
+                entries = native.journal_entries(256)
+                seqs = [e["seq"] for e in entries]
+                if seqs != sorted(seqs):
+                    raise AssertionError(f"journal seqs unsorted: "
+                                         f"{seqs[:8]}...")
+                for e in entries:
+                    if not isinstance(e["op"], str):
+                        raise AssertionError("unparsed journal op")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def epoch_runner(seed: int) -> None:
+        try:
+            rng = np.random.default_rng(seed)
+            pf = native.NativePrefetcher(rows, labels, BATCH, depth=2,
+                                         n_threads=2)
+            for _ in range(3):
+                idx = rng.permutation(ROWS).astype(np.int64)
+                for _batch in pf.iter_epoch(idx):
+                    pass
+            pf.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    pollers = [threading.Thread(target=poller) for _ in range(2)]
+    runners = [threading.Thread(target=epoch_runner, args=(10 + i,))
+               for i in range(4)]
+    for t in pollers + runners:
+        t.start()
+    for t in runners:
+        t.join()
+    stop.set()
+    for t in pollers:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def scenario_restart() -> None:
+    rows, labels = _dataset(6)
+    rng = np.random.default_rng(7)
+    pf = native.NativePrefetcher(rows, labels, BATCH, depth=4,
+                                 n_threads=2)
+    for _ in range(10):
+        idx = rng.permutation(ROWS).astype(np.int64)
+        it = pf.iter_epoch(idx)
+        next(it)                 # one batch, then abandon the epoch
+    bad = np.array([0, 1, ROWS + 7], dtype=np.int64)
+    try:
+        list(pf.iter_epoch(bad))
+    except IndexError:
+        pass
+    else:
+        raise AssertionError("out-of-range epoch was not rejected")
+    full = rng.permutation(ROWS).astype(np.int64)
+    n = sum(1 for _ in pf.iter_epoch(full))
+    if n != ROWS // BATCH:
+        raise AssertionError(f"expected {ROWS // BATCH} batches, got {n}")
+    pf.close()
+
+
+SCENARIOS = {"gather": scenario_gather, "churn": scenario_churn,
+             "journal": scenario_journal, "restart": scenario_restart}
+
+
+def main(argv) -> int:
+    names = argv[1:] or ["all"]
+    if names == ["all"]:
+        names = list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {unknown}; have "
+              f"{list(SCENARIOS)} or 'all'", file=sys.stderr)
+        return 2
+    if not native.available():
+        lib = os.environ.get("TPUNET_NATIVE_LIB") or "default build"
+        print(f"native stress: library unavailable ({lib})",
+              file=sys.stderr)
+        return 3
+    for name in names:
+        SCENARIOS[name]()
+        print(f"native stress: {name} OK", flush=True)
+    print("native stress: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""ASan/UBSan/TSan gate for the native batcher (cxx/batcher.cc).
+
+The 256-slot lock-free journal ring and the prefetcher's worker
+lifecycle are exactly the code sanitizers exist for — the PR-7 heap
+corruption burned three rounds because nothing ever ran this
+extension under a memory/race detector. This gate builds sanitizer
+variants of the shared library and drives scripts/_native_stress.py
+(concurrent journal writers + live snapshot readers, create/stop/
+destroy churn, epoch cycling, concurrent gathers) in a subprocess
+with the variant loaded via ``TPUNET_NATIVE_LIB`` and the sanitizer
+runtime ``LD_PRELOAD``ed — the runtime must be first in the link
+order, and preloading is how you get there when the host binary
+(python) is uninstrumented.
+
+Usage:
+    python scripts/check_sanitizers.py                  # asan,ubsan,tsan
+    python scripts/check_sanitizers.py --variants tsan
+    python scripts/check_sanitizers.py --smoke          # ubsan only, fast
+    python scripts/check_sanitizers.py --strict         # skips fail too
+
+Exit codes: 0 = every requested variant passed or SKIPped for a
+missing toolchain (the skip is loud; --strict turns it into a
+failure), 1 = a sanitizer reported findings (its report is in the
+output), 2 = usage error. Wired into the slow suite via
+tests/test_native_sanitizers.py and into scripts/run_checks.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_CXX_DIR = os.path.join(_REPO, "cxx")
+_SRC = os.path.join(_CXX_DIR, "batcher.cc")
+_LIB_DIR = os.path.join(_REPO, "tpunet", "data", "_lib")
+_STRESS = os.path.join(_HERE, "_native_stress.py")
+
+# A distinctive exit code so a sanitizer abort can't be confused with
+# a python failure of the stress driver itself.
+_SAN_EXITCODE = 97
+
+VARIANTS: Dict[str, Dict[str, object]] = {
+    "asan": {
+        "fsanitize": "address",
+        "runtime": "libasan.so",
+        # detect_leaks=0: CPython "leaks" by design at interpreter
+        # exit; leak noise would bury real heap-corruption reports.
+        "env": {"ASAN_OPTIONS":
+                f"detect_leaks=0:abort_on_error=0:"
+                f"exitcode={_SAN_EXITCODE}"},
+    },
+    "ubsan": {
+        "fsanitize": "undefined",
+        "extra_flags": ["-fno-sanitize-recover=undefined"],
+        "runtime": "libubsan.so",
+        "env": {"UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1"},
+    },
+    "tsan": {
+        "fsanitize": "thread",
+        "runtime": "libtsan.so",
+        # report_thread_leaks=0: daemon python threads outlive main on
+        # purpose (the repo's own registry tracks them).
+        "env": {"TSAN_OPTIONS":
+                f"report_thread_leaks=0:halt_on_error=0:"
+                f"exitcode={_SAN_EXITCODE}"},
+    },
+}
+
+# Fallback for make-less hosts ONLY — keep in sync with SANFLAGS in
+# cxx/Makefile (the authoritative list; build_variant prefers make).
+_BASE_FLAGS = ["-O1", "-g", "-fno-omit-frame-pointer", "-std=c++17",
+               "-Wall", "-Werror=return-type", "-shared", "-fPIC",
+               "-pthread"]
+
+
+@dataclass
+class VariantResult:
+    variant: str
+    status: str          # "PASS" | "SKIP" | "FAIL"
+    detail: str = ""
+
+
+def _cxx() -> str:
+    return os.environ.get("CXX", "g++")
+
+
+def runtime_path(variant: str) -> Optional[str]:
+    """Resolve the sanitizer runtime .so for LD_PRELOAD via the
+    compiler, or None when the toolchain doesn't ship it."""
+    runtime = str(VARIANTS[variant]["runtime"])
+    try:
+        out = subprocess.run([_cxx(), f"-print-file-name={runtime}"],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    path = out.stdout.strip()
+    # An unknown runtime echoes the bare name back.
+    if not path or path == runtime or not os.path.exists(path):
+        return None
+    return os.path.abspath(path)
+
+
+def toolchain_supports(variant: str) -> Tuple[bool, str]:
+    """(supported, why-not): probe-compiles a trivial TU with the
+    sanitizer flag and resolves the preloadable runtime."""
+    if not os.path.exists(_SRC):
+        return False, f"source missing: {_SRC}"
+    fsan = str(VARIANTS[variant]["fsanitize"])
+    with tempfile.TemporaryDirectory(prefix="tpunet-san-") as tmp:
+        probe = os.path.join(tmp, "probe.cc")
+        with open(probe, "w", encoding="utf-8") as f:
+            f.write("int main() { return 0; }\n")
+        try:
+            res = subprocess.run(
+                [_cxx(), f"-fsanitize={fsan}", probe, "-o",
+                 os.path.join(tmp, "probe")],
+                capture_output=True, text=True, timeout=60)
+        except (OSError, subprocess.SubprocessError) as e:
+            return False, f"compiler unavailable: {e}"
+        if res.returncode != 0:
+            return False, (f"{_cxx()} cannot link -fsanitize={fsan}: "
+                           f"{res.stderr.strip().splitlines()[-1:]}")
+    if runtime_path(variant) is None:
+        return False, (f"no preloadable {VARIANTS[variant]['runtime']} "
+                       f"(needed because python itself is "
+                       "uninstrumented)")
+    return True, ""
+
+
+def build_variant(variant: str) -> Tuple[Optional[str], str]:
+    """Build the sanitizer .so. The cxx/Makefile targets are the
+    single source of the flag set — ``make -C cxx <variant>`` builds
+    EXACTLY the binary a human reproducing a report builds; the
+    direct-compile path below exists only for make-less hosts and
+    mirrors SANFLAGS. Returns (path, error)."""
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    out = os.path.join(_LIB_DIR, f"libtnbatcher_{variant}.so")
+    try:
+        res = subprocess.run(
+            ["make", "-C", _CXX_DIR, "-B", variant],
+            capture_output=True, text=True, timeout=300)
+        if res.returncode == 0:
+            return out, ""
+        make_err: Optional[str] = res.stderr
+    except OSError:
+        make_err = None      # no make on this host: fall through
+    except subprocess.SubprocessError as e:
+        make_err = str(e)
+    if make_err is not None:
+        return None, f"make -C cxx {variant} failed:\n{make_err}"
+    fsan = str(VARIANTS[variant]["fsanitize"])
+    extra = [str(f) for f in VARIANTS[variant].get("extra_flags", [])]
+    cmd = ([_cxx()] + _BASE_FLAGS + [f"-fsanitize={fsan}"] + extra
+           + [_SRC, "-o", out])
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=300)
+    except (OSError, subprocess.SubprocessError) as e:
+        return None, f"build failed: {e}"
+    if res.returncode != 0:
+        return None, f"build failed:\n{res.stderr}"
+    return out, ""
+
+
+def run_variant(variant: str, scenarios: Sequence[str] = ("all",),
+                timeout_s: float = 600.0) -> VariantResult:
+    """Build one variant and run the stress driver under it."""
+    supported, why = toolchain_supports(variant)
+    if not supported:
+        return VariantResult(variant, "SKIP", why)
+    lib, err = build_variant(variant)
+    if lib is None:
+        return VariantResult(variant, "FAIL", err)
+    runtime = runtime_path(variant)
+    assert runtime is not None  # toolchain_supports checked
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)   # the driver never imports jax
+    env["TPUNET_NATIVE_LIB"] = lib
+    env["LD_PRELOAD"] = runtime
+    env.update({k: str(v) for k, v in
+                dict(VARIANTS[variant]["env"]).items()})  # type: ignore[arg-type]
+    cmd = [sys.executable, _STRESS] + list(scenarios)
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             env=env, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return VariantResult(variant, "FAIL",
+                             f"stress timed out after {timeout_s}s "
+                             "(wedged worker join?)")
+    except OSError as e:
+        return VariantResult(variant, "FAIL", f"could not run: {e}")
+    tail = "\n".join((res.stdout + "\n" + res.stderr)
+                     .strip().splitlines()[-40:])
+    if res.returncode == 0:
+        return VariantResult(variant, "PASS", tail.splitlines()[-1]
+                             if tail else "")
+    label = ("sanitizer report"
+             if res.returncode == _SAN_EXITCODE or res.returncode < 0
+             else f"driver exit {res.returncode}")
+    return VariantResult(
+        variant, "FAIL",
+        f"{label} (cmd: {shlex.join(cmd)})\n{tail}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="sanitizer gate for the native batcher "
+                    "(docs/static_analysis.md, sanitizer matrix)")
+    p.add_argument("--variants", default="asan,ubsan,tsan",
+                   help="comma-separated subset of asan,ubsan,tsan")
+    p.add_argument("--smoke", action="store_true",
+                   help="fast pre-merge mode: ubsan only, churn+restart "
+                        "scenarios")
+    p.add_argument("--scenarios", default="all",
+                   help="comma-separated stress scenarios "
+                        "(gather,churn,journal,restart or 'all')")
+    p.add_argument("--strict", action="store_true",
+                   help="a toolchain SKIP fails the gate")
+    p.add_argument("--timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        variants = ["ubsan"]
+        scenarios = ["churn", "restart"]
+    else:
+        variants = [v.strip() for v in args.variants.split(",")
+                    if v.strip()]
+        scenarios = [s.strip() for s in args.scenarios.split(",")
+                     if s.strip()]
+    unknown = [v for v in variants if v not in VARIANTS]
+    if unknown:
+        print(f"check_sanitizers: unknown variant(s) {unknown}; have "
+              f"{list(VARIANTS)}", file=sys.stderr)
+        return 2
+
+    results = [run_variant(v, scenarios, args.timeout)
+               for v in variants]
+    failed = False
+    for r in results:
+        print(f"[{r.status}] {r.variant}"
+              + (f": {r.detail}" if r.detail else ""))
+        if r.status == "FAIL":
+            failed = True
+        elif r.status == "SKIP":
+            print(f"  NOTE: {r.variant} SKIPPED — this host's "
+                  "toolchain cannot run it; the batcher's concurrency "
+                  "is UNVERIFIED by this variant here. Run on a host "
+                  f"with g++ + {VARIANTS[r.variant]['runtime']}.")
+            if args.strict:
+                failed = True
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

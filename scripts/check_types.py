@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Type gate for the newest subsystems (tpunet/analysis, tpunet/obs/
+flightrec).
+
+Two layers, so annotations can't rot even on hosts without a type
+checker installed:
+
+1. **mypy**, when importable: runs with the ``[tool.mypy]`` config in
+   pyproject.toml (strict ``disallow_untyped_defs`` over
+   ``tpunet.analysis``, ``check_untyped_defs`` over flightrec).
+   Missing mypy is a LOUD skip of this layer, not a pass of it —
+   the container bakes its own deps and this repo does not install.
+2. **annotation coverage** (stdlib ast, always runs): every function
+   in ``tpunet/analysis/`` must annotate its return and every
+   parameter (self/cls excepted); every PUBLIC def in
+   ``tpunet/obs/flightrec/`` must as well. This is the floor that
+   makes layer 1 meaningful the day mypy does run.
+
+Exit codes: 0 = pass (mypy may have skipped, said loudly), 1 =
+coverage gap or mypy errors, 2 = internal error. Wired as a non-slow
+test (tests/test_types.py) and into scripts/run_checks.sh.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import sys
+from typing import Iterator, List, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(_HERE)
+
+#: (directory, public_only) — analysis is fully strict, flightrec is
+#: public-surface strict.
+TARGETS: Tuple[Tuple[str, bool], ...] = (
+    (os.path.join("tpunet", "analysis"), False),
+    (os.path.join("tpunet", "obs", "flightrec"), True),
+)
+
+
+def _py_files(rel_dir: str) -> Iterator[str]:
+    root = os.path.join(REPO, rel_dir)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def _unannotated(fn: ast.AST, public_only: bool,
+                 in_class: bool) -> List[str]:
+    """Parameter/return annotation gaps of one function def."""
+    assert isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+    if public_only and fn.name.startswith("_") \
+            and not (fn.name.startswith("__") and fn.name.endswith("__")):
+        return []
+    gaps: List[str] = []
+    args = fn.args
+    params = args.posonlyargs + args.args + args.kwonlyargs
+    skip_first = in_class and params and params[0].arg in ("self", "cls")
+    for i, a in enumerate(params):
+        if skip_first and i == 0:
+            continue
+        if a.annotation is None:
+            gaps.append(f"param '{a.arg}'")
+    for star in (args.vararg, args.kwarg):
+        if star is not None and star.annotation is None:
+            gaps.append(f"param '*{star.arg}'")
+    if fn.returns is None and fn.name != "__init__":
+        gaps.append("return")
+    return gaps
+
+
+def annotation_gaps() -> List[str]:
+    """All annotation-coverage violations across TARGETS, rendered as
+    'path:line: def name: missing ...' strings."""
+    out: List[str] = []
+    for rel_dir, public_only in TARGETS:
+        for path in _py_files(rel_dir):
+            rel = os.path.relpath(path, REPO)
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=rel)
+
+            def visit(node: ast.AST, in_class: bool) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        gaps = _unannotated(child, public_only, in_class)
+                        if gaps:
+                            out.append(f"{rel}:{child.lineno}: def "
+                                       f"{child.name}: missing "
+                                       + ", ".join(gaps))
+                        visit(child, in_class=False)
+                    elif isinstance(child, ast.ClassDef):
+                        visit(child, in_class=True)
+                    else:
+                        visit(child, in_class=in_class)
+
+            visit(tree, in_class=False)
+    return out
+
+
+def run_mypy() -> Tuple[str, int]:
+    """('ran'|'skipped', exit code). Skip only when mypy is absent."""
+    try:
+        import mypy  # noqa: F401
+    except ImportError:
+        print("check_types: NOTE — mypy is not installed in this "
+              "environment; the mypy layer is SKIPPED (annotation-"
+              "coverage layer still enforced). The [tool.mypy] config "
+              "in pyproject.toml is the contract a mypy-equipped host "
+              "runs.", flush=True)
+        return "skipped", 0
+    res = subprocess.run(
+        [sys.executable, "-m", "mypy", "--config-file",
+         os.path.join(REPO, "pyproject.toml")]
+        + [os.path.join(REPO, d) for d, _ in TARGETS],
+        capture_output=True, text=True, cwd=REPO, timeout=600)
+    if res.returncode != 0:
+        print(res.stdout)
+        print(res.stderr, file=sys.stderr)
+    return "ran", res.returncode
+
+
+def main() -> int:
+    gaps = annotation_gaps()
+    for gap in gaps:
+        print(f"check_types: {gap}")
+    status, mypy_rc = run_mypy()
+    if gaps:
+        print(f"check_types: FAIL — {len(gaps)} annotation gap(s)")
+        return 1
+    if mypy_rc != 0:
+        print("check_types: FAIL — mypy errors")
+        return 1
+    print(f"check_types: OK (coverage clean; mypy {status})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# Pre-merge gate aggregator: every repo-native check behind one exit
+# code (docs/static_analysis.md "Pre-merge command"). Fast set by
+# default; --slow adds the measured gates (obs overhead A/B, the full
+# sanitizer matrix). A gate whose input artifact does not exist on
+# this tree SKIPs with a note — a skip is printed, never silent.
+#
+# Usage:
+#   scripts/run_checks.sh            # fast: tpucheck, types, schema,
+#                                    # budgets (artifact-gated),
+#                                    # sanitizer smoke
+#   scripts/run_checks.sh --slow     # + obs overhead, full asan/ubsan/
+#                                    # tsan stress matrix
+#
+# Exit: 0 = every gate PASS or SKIP, 1 = any gate FAILED.
+
+set -u
+cd "$(dirname "$0")/.."
+
+SLOW=0
+for arg in "$@"; do
+  case "$arg" in
+    --slow) SLOW=1 ;;
+    *) echo "usage: scripts/run_checks.sh [--slow]" >&2; exit 2 ;;
+  esac
+done
+
+FAILED=0
+SUMMARY=""
+
+run_gate() {       # run_gate <name> <cmd...>
+  local name="$1"; shift
+  echo "=== [$name] $*"
+  if "$@"; then
+    SUMMARY="$SUMMARY
+[PASS] $name"
+  else
+    local rc=$?
+    SUMMARY="$SUMMARY
+[FAIL] $name (exit $rc)"
+    FAILED=1
+  fi
+}
+
+skip_gate() {      # skip_gate <name> <why>
+  echo "=== [$1] SKIP: $2"
+  SUMMARY="$SUMMARY
+[SKIP] $1 — $2"
+}
+
+run_gate "tpucheck" python -m tpunet.analysis --strict-baseline
+run_gate "types" python scripts/check_types.py
+run_gate "metrics-schema" python scripts/check_metrics_schema.py
+
+# Bytes budget gates the newest BENCH artifact measured AFTER the
+# budget's as_of_round (the same eligibility rule as
+# tests/test_hbm_bytes.py::test_budget_vs_latest_bench_artifact).
+BENCH_ARTIFACT=$(python - <<'EOF'
+import glob, json, os, re
+budget = json.load(open(os.path.join("docs", "bytes_budget.json")))
+as_of = max(int(b.get("as_of_round", 0))
+            for b in budget.get("budgets", {}).values())
+best = None
+for path in glob.glob("BENCH_r*.json"):
+    m = re.match(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    if m and int(m.group(1)) > as_of:
+        best = max(best or "", path)
+print(best or "")
+EOF
+)
+if [ -n "$BENCH_ARTIFACT" ]; then
+  run_gate "bytes-budget" python scripts/check_bytes_budget.py "$BENCH_ARTIFACT"
+else
+  skip_gate "bytes-budget" "no BENCH_rN artifact newer than the budget's as_of_round (the tier-1 drift test enforces reconciliation when one lands)"
+fi
+
+if ls SERVE_BENCH*.json >/dev/null 2>&1; then
+  run_gate "serve-budget" python scripts/check_serve_budget.py SERVE_BENCH*.json
+else
+  skip_gate "serve-budget" "no SERVE_BENCH*.json artifact (run scripts/bench_serve.py --enforce-budget to gate in-process)"
+fi
+
+run_gate "sanitizer-smoke" python scripts/check_sanitizers.py --smoke
+
+if [ "$SLOW" = 1 ]; then
+  run_gate "sanitizers-full" python scripts/check_sanitizers.py
+  run_gate "obs-overhead" python scripts/check_obs_overhead.py
+fi
+
+echo
+echo "=== run_checks summary ==="
+echo "$SUMMARY" | sed '/^$/d'
+if [ "$FAILED" = 1 ]; then
+  echo "run_checks: FAILED"
+  exit 1
+fi
+echo "run_checks: OK"

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""tpucheck: repo-native JAX/TPU static analysis (thin wrapper around
+``python -m tpunet.analysis`` for people who tab-complete scripts/).
+
+Rule catalog, baseline semantics, and suppression syntax:
+docs/static_analysis.md. Part of the pre-merge gate
+(scripts/run_checks.sh).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tpunet.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

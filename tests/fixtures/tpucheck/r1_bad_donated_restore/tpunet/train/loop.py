@@ -1,0 +1,30 @@
+# tpucheck R1 regression fixture: the PR-7 resume heap-corruption
+# pattern — orbax-restored state donated into the jitted train step
+# without re-materialization. Parsed only, never imported.
+import jax
+
+
+class Trainer:
+    def __init__(self, cfg, ckpt, train_fn):
+        self.ckpt = ckpt
+        self.train_step = jax.jit(train_fn, donate_argnums=0)
+        self.state = None
+        if cfg.resume:
+            self._try_resume()
+
+    def _try_resume(self):
+        restored = self.ckpt.restore_state(self._payload())
+        if restored is None:
+            return
+        self.state = restored["state"]
+
+    def _payload(self):
+        return {"state": self.state}
+
+    def train(self, batches):
+        for batch, labels, rng in batches:
+            # BUG (by construction): self.state still aliases the
+            # restore's host buffers on the first post-resume step.
+            self.state, metrics = self.train_step(self.state, batch,
+                                                  labels, rng)
+        return self.state

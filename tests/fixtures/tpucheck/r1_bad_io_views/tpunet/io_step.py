@@ -1,0 +1,19 @@
+# tpucheck R1 fixture: module-level IO-origin views (np.load /
+# np.asarray over a foreign buffer) into donated jit args, positional
+# and by-name. Parsed only, never imported.
+import jax
+import numpy as np
+
+
+def _step(state, batch):
+    return state
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+named_step = jax.jit(_step, donate_argnames=("state",))
+
+weights = np.load("weights.npy")
+step(weights, None)
+
+view = np.asarray(memoryview(b"romp"))
+named_step(state=view, batch=None)

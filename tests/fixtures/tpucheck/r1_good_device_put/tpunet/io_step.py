@@ -1,0 +1,22 @@
+# tpucheck R1 good fixture: device_put re-materializes before
+# donation; reassignment from a fresh producer clears taint.
+import jax
+import numpy as np
+
+
+def _step(state, batch):
+    return state
+
+
+def fresh_state():
+    return {"w": 0}
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+weights = jax.device_put(np.load("weights.npy"))
+step(weights, None)
+
+state = np.load("ckpt.npy")
+state = fresh_state()
+step(state, None)

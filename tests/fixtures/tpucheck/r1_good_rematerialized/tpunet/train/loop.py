@@ -1,0 +1,23 @@
+# tpucheck R1 good fixture: the PR-7 FIX — restored state is
+# re-materialized (tree_map(jnp.copy)) before the donated call.
+import jax
+import jax.numpy as jnp
+
+
+class Trainer:
+    def __init__(self, cfg, ckpt, train_fn):
+        self.ckpt = ckpt
+        self.train_step = jax.jit(train_fn, donate_argnums=0)
+        self.state = None
+
+    def _try_resume(self):
+        restored = self.ckpt.restore_state({"state": self.state})
+        if restored is None:
+            return
+        self.state = jax.tree_util.tree_map(jnp.copy, restored["state"])
+
+    def train(self, batches):
+        for batch, labels, rng in batches:
+            self.state, metrics = self.train_step(self.state, batch,
+                                                  labels, rng)
+        return self.state

@@ -1,0 +1,33 @@
+# tpucheck R2 regression fixture: the PR-6 pattern — a custom_vjp'd
+# Pallas kernel whose fwd/bwd carry NO tpunet_* named scope, so its
+# custom calls attribute to 'elementwise' and the backward to the fwd
+# phase. Parsed only, never imported.
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _invoke(x):
+    return pl.pallas_call(_kernel, out_shape=x)(x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_op(x):
+    return _invoke(x)
+
+
+def _fwd(x):
+    return _invoke(x), (x,)
+
+
+def _bwd(res, g):
+    (x,) = res
+    return (_invoke(g),)
+
+
+fused_op.defvjp(_fwd, _bwd)

@@ -1,0 +1,14 @@
+# tpucheck R2 fixture: scoped, but with a marker hlo_bytes'
+# KERNEL_SCOPES does not know — attribution would silently bucket it
+# into 'elementwise'. Parsed only, never imported.
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def mystery_op(x):
+    with jax.named_scope("tpunet_mystery_fwd"):
+        return pl.pallas_call(_kernel, out_shape=x)(x)

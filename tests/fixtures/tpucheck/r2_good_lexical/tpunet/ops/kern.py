@@ -1,0 +1,31 @@
+# tpucheck R2 good fixture: kernel calls and custom_vjp fwd/bwd all
+# lexically under registered tpunet_* scopes (the flash layout).
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_op(x):
+    with jax.named_scope("tpunet_fused_ir_fwd"):
+        return pl.pallas_call(_kernel, out_shape=x)(x)
+
+
+def _fwd(x):
+    with jax.named_scope("tpunet_fused_ir_fwd"):
+        y = pl.pallas_call(_kernel, out_shape=x)(x)
+    return y, (x,)
+
+
+def _bwd(res, g):
+    (x,) = res
+    with jax.named_scope("tpunet_fused_ir_bwd"):
+        return (pl.pallas_call(_kernel, out_shape=g)(g),)
+
+
+fused_op.defvjp(_fwd, _bwd)

@@ -1,0 +1,25 @@
+# tpucheck R3 fixture: numpy on a traced value and global mutation
+# inside jit/shard_map bodies.
+import functools
+
+import jax
+import numpy as np
+
+_STEPS = 0
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def loss_step(batch, scale):
+    global _STEPS
+    _STEPS = _STEPS + 1
+    return np.mean(batch) * scale
+
+
+def _shard_body(x):
+    return np.sum(x)
+
+
+def build(mesh):
+    from jax.experimental.shard_map import shard_map
+    return shard_map(_shard_body, mesh=mesh, in_specs=None,
+                     out_specs=None)

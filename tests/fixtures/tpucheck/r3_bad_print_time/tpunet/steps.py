@@ -1,0 +1,19 @@
+# tpucheck R3 fixture: print and wall-clock reads inside jitted
+# bodies — both run once at trace time and never again.
+import time
+
+import jax
+
+
+@jax.jit
+def train_step(state, batch):
+    print("step!", batch)
+    return state
+
+
+def _timed(state):
+    t0 = time.perf_counter()
+    return state, t0
+
+
+timed_step = jax.jit(_timed)

@@ -1,0 +1,19 @@
+# tpucheck R3 good fixture: the same side effects OUTSIDE jit are
+# host code and perfectly fine; jax.debug.* inside jit is sanctioned.
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def train_step(state, batch):
+    jax.debug.print("loss {l}", l=batch)
+    return state, jnp.mean(batch)
+
+
+def epoch(batches):
+    t0 = time.perf_counter()
+    for batch in batches:
+        print("host-side progress", batch.shape)
+    return time.perf_counter() - t0

@@ -1,0 +1,21 @@
+# tpucheck R3 good fixture: numpy on STATIC values (shapes, closure
+# constants) inside jit is trace-time math by design — only numpy on
+# traced parameters is the bug; callbacks are the sanctioned bridge.
+import jax
+import numpy as np
+
+SHAPE = (8, 128)
+
+
+def _record(x):
+    pass
+
+
+@jax.jit
+def padded_step(batch):
+    n = int(np.prod(SHAPE))
+    jax.experimental.io_callback(_record, None, batch)
+    return batch.reshape(n)
+
+
+step = jax.jit(padded_step)

@@ -1,0 +1,8 @@
+# tpucheck R4 fixture: a long-lived child process spawned without
+# any registry/inventory trace.
+import subprocess
+import sys
+
+
+def launch_sidecar(path):
+    return subprocess.Popen([sys.executable, path])

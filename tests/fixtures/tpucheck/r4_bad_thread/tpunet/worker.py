@@ -1,0 +1,14 @@
+# tpucheck R4 fixture: a background thread invisible to the
+# flightrec host-thread registry.
+import threading
+
+
+class Exporter:
+    def start(self):
+        self._thread = threading.Thread(target=self._drain,
+                                        daemon=True,
+                                        name="rogue-exporter")
+        self._thread.start()
+
+    def _drain(self):
+        pass

@@ -1,0 +1,25 @@
+# tpucheck R4 good fixture: the sanctioned idiom — register in the
+# spawning scope, beat in the worker; synchronous subprocess.run is
+# not a spawn (the child is reaped before the call returns).
+import subprocess
+import threading
+
+from tpunet.obs.flightrec import register_thread
+
+
+class Exporter:
+    def start(self):
+        self._handle = register_thread("exporter-drain",
+                                       stall_after_s=120.0)
+        self._thread = threading.Thread(target=self._drain,
+                                        daemon=True,
+                                        name="exporter-drain")
+        self._thread.start()
+
+    def _drain(self):
+        self._handle.beat("busy")
+        self._handle.beat("idle")
+
+
+def build_lib():
+    subprocess.run(["make", "-C", "cxx"], check=True)

@@ -1,0 +1,11 @@
+# tpucheck R4 good fixture: inline suppression — the line-level
+# escape hatch for a reviewed, justified exception.
+import threading
+
+
+def fire_and_forget(fn):
+    # one-shot timer thread, dies in <1ms; registry churn would cost
+    # more than the inventory is worth here
+    t = threading.Thread(target=fn)  # tpucheck: disable=R4
+    t.start()
+    return t

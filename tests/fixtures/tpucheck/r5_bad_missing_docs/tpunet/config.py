@@ -1,0 +1,17 @@
+# tpucheck R5 fixture: ServeConfig.queue_max is flagged but
+# undocumented — no markdown mentions the field or its flag.
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    host: str = "127.0.0.1"
+    queue_max: int = 64
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--queue-max", type=int, default=64)
+    return p

@@ -1,0 +1,15 @@
+# tpucheck R5 fixture: ServeConfig.queue_max has no CLI flag.
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    host: str = "127.0.0.1"
+    queue_max: int = 64
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    return p

@@ -1,0 +1,15 @@
+# tpucheck R5 good fixture: a boolean field wired through its
+# --no-X negation form (the --no-obs idiom).
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ObsConfig:
+    enabled: bool = True
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--no-enabled", action="store_true")
+    return p

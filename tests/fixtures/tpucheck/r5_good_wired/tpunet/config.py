@@ -1,0 +1,24 @@
+# tpucheck R5 good fixture: every field has a flag and a docs
+# mention; nested sub-config fields are their own surface and are
+# not judged here.
+import argparse
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ExportConfig:
+    statsd: str = ""
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    host: str = "127.0.0.1"
+    queue_max: int = 64
+    export: ExportConfig = field(default_factory=ExportConfig)
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--queue-max", type=int, default=64)
+    return p

@@ -1,0 +1,130 @@
+"""Sanitizer builds of the native batcher (scripts/check_sanitizers.py).
+
+The slow tests build ASan/UBSan/TSan variants of cxx/batcher.cc and
+drive the full stress matrix (concurrent journal writers + live
+snapshot readers, create/stop/destroy churn, epoch cycling,
+concurrent gathers) with the variant loaded via TPUNET_NATIVE_LIB and
+the runtime LD_PRELOADed. A host whose toolchain can't run a variant
+SKIPS — loudly, because a skip means the batcher's concurrency went
+unverified here, not that it is fine.
+
+The seqlock regression matters most: the journal ring used to write
+plain fields "racy by design" (a formal C++ data race — the first
+TSan run of the old code reported ~50 races in journal_snapshot);
+test_tsan_stress is what keeps the ring honest.
+
+Non-slow tests cover the gate's own plumbing (variant parsing, env
+wiring, the native-lib override) without compiling anything.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_sanitizers  # noqa: E402
+
+
+def _stress_env(lib_path):
+    env = dict(os.environ)
+    env["TPUNET_NATIVE_LIB"] = str(lib_path)
+    env.pop("LD_PRELOAD", None)
+    return env
+
+
+# -- non-slow: gate plumbing ------------------------------------------
+
+def test_unknown_variant_is_usage_error():
+    assert check_sanitizers.main(["--variants", "msan"]) == 2
+
+
+def test_variant_table_covers_cli_default():
+    defaults = {"asan", "ubsan", "tsan"}
+    assert set(check_sanitizers.VARIANTS) == defaults
+    for spec in check_sanitizers.VARIANTS.values():
+        assert "fsanitize" in spec and "runtime" in spec and "env" in spec
+
+
+def test_native_lib_override_requires_existing_file(tmp_path):
+    """TPUNET_NATIVE_LIB pointing at a missing .so must fail the
+    child (exit 3), never silently fall back to the default build —
+    a sanitizer gate that tests the wrong library would always pass."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "_native_stress.py"), "restart"],
+        env=_stress_env(tmp_path / "nope.so"),
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 3, res.stdout + res.stderr
+    assert "unavailable" in res.stderr
+
+
+def test_stress_driver_unknown_scenario_is_usage_error():
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "_native_stress.py"), "bogus"],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 2
+
+
+def test_stress_driver_passes_on_plain_build():
+    """The stress scenarios themselves hold on the default (see
+    check_sanitizers for the instrumented runs)."""
+    from tpunet.data import native
+    if not native.available():
+        pytest.skip("native batcher unavailable (no C++ toolchain)")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "_native_stress.py"), "churn",
+         "restart"],
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PASS" in res.stdout
+
+
+# -- slow: the sanitizer matrix ---------------------------------------
+
+def _run(variant):
+    result = check_sanitizers.run_variant(variant)
+    if result.status == "SKIP":
+        pytest.skip(
+            f"TOOLCHAIN LIMITATION — {variant} sanitizer cannot run "
+            f"here ({result.detail}); the native batcher's "
+            f"concurrency is UNVERIFIED by {variant} on this host. "
+            f"Run scripts/check_sanitizers.py on a host with g++ + "
+            f"{check_sanitizers.VARIANTS[variant]['runtime']}.")
+    assert result.status == "PASS", \
+        f"{variant} reported findings:\n{result.detail}"
+
+
+@pytest.mark.slow
+def test_asan_stress():
+    _run("asan")
+
+
+@pytest.mark.slow
+def test_ubsan_stress():
+    _run("ubsan")
+
+
+@pytest.mark.slow
+def test_tsan_stress():
+    """TSan over the lock-free journal ring + worker lifecycle — the
+    variant the ring's seqlock rewrite exists for."""
+    _run("tsan")
+
+
+@pytest.mark.slow
+def test_sanitizer_gate_cli_smoke():
+    """The doc'd pre-merge entry point (exit-coded)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_sanitizers.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "[PASS] ubsan" in res.stdout or "[SKIP] ubsan" in res.stdout

@@ -1,0 +1,239 @@
+"""tpucheck (tpunet/analysis/): rule fixtures, baseline semantics,
+suppressions, CLI exit codes, and the tree-is-clean gate.
+
+The fixture matrix under tests/fixtures/tpucheck/ carries the repo's
+regression history: ``r1_bad_donated_restore`` is the PR-7
+donated-orbax-restore heap corruption, ``r2_bad_scopeless_vjp`` the
+PR-6 scope-less custom_vjp misattribution — each must stay RED
+forever. ``test_tree_is_clean_against_baseline`` is the gate itself:
+``python -m tpunet.analysis`` on this repo must exit 0 (clean or
+baselined) on every commit.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "tpucheck")
+
+from tpunet.analysis import (ALL_RULES, Project, rules_by_id,  # noqa: E402
+                             run_rules)
+from tpunet.analysis import baseline as baseline_mod  # noqa: E402
+from tpunet.analysis.__main__ import main as tpucheck_main  # noqa: E402
+
+
+def _run_fixture(case, rule_id):
+    root = os.path.join(FIXTURES, case)
+    assert os.path.isdir(root), root
+    return run_rules(Project(root), [rules_by_id()[rule_id]])
+
+
+# -- fixture matrix: every bad case fires its rule, every good case is
+# clean under it ------------------------------------------------------
+
+BAD_CASES = [
+    ("r1_bad_donated_restore", "R1", 1),
+    ("r1_bad_io_views", "R1", 2),
+    ("r2_bad_scopeless_vjp", "R2", 3),   # fwd + bwd + naked primal kernel
+    ("r2_bad_unknown_scope", "R2", 1),
+    ("r3_bad_print_time", "R3", 2),
+    ("r3_bad_numpy_global", "R3", 3),
+    ("r4_bad_thread", "R4", 1),
+    ("r4_bad_popen", "R4", 1),
+    ("r5_bad_missing_flag", "R5", 1),
+    ("r5_bad_missing_docs", "R5", 1),
+]
+
+GOOD_CASES = [
+    ("r1_good_rematerialized", "R1"),
+    ("r1_good_device_put", "R1"),
+    ("r2_good_lexical", "R2"),
+    ("r2_good_wrapper", "R2"),
+    ("r3_good_host_side", "R3"),
+    ("r3_good_static_numpy", "R3"),
+    ("r4_good_registered", "R4"),
+    ("r4_good_suppressed", "R4"),
+    ("r5_good_wired", "R5"),
+    ("r5_good_bool_negation", "R5"),
+]
+
+
+@pytest.mark.parametrize("case,rule_id,min_findings", BAD_CASES)
+def test_bad_fixture_fires(case, rule_id, min_findings):
+    findings = _run_fixture(case, rule_id)
+    assert len(findings) >= min_findings, \
+        f"{case}: expected >= {min_findings} {rule_id} findings, " \
+        f"got {[f.render() for f in findings]}"
+    assert all(f.rule == rule_id for f in findings)
+    for f in findings:
+        assert f.line > 0 and f.path and f.key, f
+        assert f.hint, f"finding without a fix hint: {f.render()}"
+
+
+@pytest.mark.parametrize("case,rule_id", GOOD_CASES)
+def test_good_fixture_clean(case, rule_id):
+    findings = _run_fixture(case, rule_id)
+    assert findings == [], [f.render() for f in findings]
+
+
+# -- the named regression semantics, not just counts ------------------
+
+def test_pr7_donated_restore_regression():
+    """The exact PR-7 shape: restore -> self.state -> donated arg 0 of
+    the jitted train step, flagged AT the call site."""
+    findings = _run_fixture("r1_bad_donated_restore", "R1")
+    f = findings[0]
+    assert "restore_state" in f.message
+    assert "donated arg 0" in f.message
+    assert f.key == "donate:self.train_step<-self.state"
+    assert f.path == "tpunet/train/loop.py"
+
+
+def test_pr6_scopeless_vjp_regression():
+    """The PR-6 shape: both custom_vjp halves flagged, the bwd finding
+    naming the transpose(-marker gap."""
+    findings = _run_fixture("r2_bad_scopeless_vjp", "R2")
+    roles = {f.key for f in findings if f.key.startswith("vjp:")}
+    assert "vjp:fused_op:fwd:_fwd" in roles
+    assert "vjp:fused_op:bwd:_bwd" in roles
+    bwd = [f for f in findings if ":bwd:" in f.key][0]
+    assert "transpose(" in bwd.message
+
+
+def test_r2_unknown_scope_names_marker_table():
+    findings = _run_fixture("r2_bad_unknown_scope", "R2")
+    assert any(f.key == "marker:tpunet_mystery_fwd" for f in findings)
+    assert any("KERNEL_SCOPES" in f.message for f in findings)
+
+
+def test_r3_flags_each_effect_kind():
+    kinds = {f.key.split(":")[1]
+             for f in (_run_fixture("r3_bad_print_time", "R3")
+                       + _run_fixture("r3_bad_numpy_global", "R3"))}
+    assert {"print", "time", "numpy", "global"} <= kinds
+
+
+# -- suppressions and baseline ----------------------------------------
+
+def test_inline_suppression_is_line_scoped(tmp_path):
+    proj = tmp_path / "tpunet"
+    proj.mkdir()
+    (proj / "w.py").write_text(
+        "import threading\n"
+        "a = threading.Thread(target=print)  # tpucheck: disable=R4\n"
+        "b = threading.Thread(target=print)\n")
+    findings = run_rules(Project(str(tmp_path)), [rules_by_id()["R4"]])
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+def test_suppression_wrong_rule_does_not_apply(tmp_path):
+    proj = tmp_path / "tpunet"
+    proj.mkdir()
+    (proj / "w.py").write_text(
+        "import threading\n"
+        "a = threading.Thread(target=print)  # tpucheck: disable=R1\n")
+    findings = run_rules(Project(str(tmp_path)), [rules_by_id()["R4"]])
+    assert len(findings) == 1
+
+
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    root = os.path.join(FIXTURES, "r1_bad_donated_restore")
+    findings = run_rules(Project(root), [rules_by_id()["R1"]])
+    assert findings
+    path = str(tmp_path / "baseline.json")
+
+    # write-baseline produces TODO entries the loader refuses...
+    todo = baseline_mod.write(path, findings, baseline_mod.Baseline())
+    assert todo == len({f.identity() for f in findings})
+    with pytest.raises(ValueError, match="TODO"):
+        baseline_mod.load(path)
+
+    # ...until a human writes the why; then the findings are accepted.
+    with open(path) as f:
+        data = json.load(f)
+    for e in data["entries"]:
+        e["why"] = "fixture: intentionally kept"
+    with open(path, "w") as f:
+        json.dump(data, f)
+    bl = baseline_mod.load(path)
+    new, accepted, stale = bl.split(findings)
+    assert new == [] and len(accepted) == len(findings) and stale == []
+
+    # a fixed tree sheds the entry: same baseline, no findings -> stale
+    new, accepted, stale = bl.split([])
+    assert new == [] and accepted == [] and len(stale) >= 1
+
+
+def test_baseline_rejects_unjustified_entries(tmp_path):
+    path = str(tmp_path / "b.json")
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": [
+            {"rule": "R1", "path": "x.py", "key": "k"}]}, f)
+    with pytest.raises(ValueError, match="why"):
+        baseline_mod.load(path)
+
+
+# -- CLI ---------------------------------------------------------------
+
+def test_cli_exit_codes_in_process():
+    bad = os.path.join(FIXTURES, "r4_bad_thread")
+    good = os.path.join(FIXTURES, "r4_good_registered")
+    assert tpucheck_main(["--root", bad, "--baseline", "none"]) == 1
+    assert tpucheck_main(["--root", good, "--baseline", "none"]) == 0
+    assert tpucheck_main(["--list-rules"]) == 0
+    assert tpucheck_main(["--rules", "R9", "--root", good]) == 2
+
+
+def test_cli_json_output(capsys):
+    bad = os.path.join(FIXTURES, "r3_bad_print_time")
+    rc = tpucheck_main(["--root", bad, "--baseline", "none", "--json",
+                        "--rules", "R3"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"] and payload["baselined"] == []
+    assert {"rule", "path", "line", "message", "hint", "key"} <= set(
+        payload["findings"][0])
+
+
+def test_cli_module_entry_subprocess():
+    """``python -m tpunet.analysis`` (the doc'd invocation) exits 1 on
+    a bad fixture and 0 with --list-rules."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    bad = os.path.join(FIXTURES, "r2_bad_scopeless_vjp")
+    res = subprocess.run(
+        [sys.executable, "-m", "tpunet.analysis", "--root", bad,
+         "--baseline", "none"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "[R2]" in res.stdout
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    proj = tmp_path / "tpunet"
+    proj.mkdir()
+    (proj / "broken.py").write_text("def oops(:\n")
+    findings = run_rules(Project(str(tmp_path)), list(ALL_RULES))
+    assert any(f.rule == "PARSE" for f in findings)
+
+
+# -- the gate ---------------------------------------------------------
+
+def test_tree_is_clean_against_baseline():
+    """THE tier-1 invariant: tpucheck on this repo exits 0 — every
+    finding either fixed or baselined with a justification. Stale
+    entries fail too: fixed code must shed its ledger line."""
+    rc = tpucheck_main(["--root", REPO, "--strict-baseline"])
+    assert rc == 0, "tpucheck found unbaselined findings (or stale " \
+                    "baseline entries); run python -m tpunet.analysis"
+
+
+def test_checked_in_baseline_is_justified():
+    bl = baseline_mod.load(os.path.join(REPO, "docs",
+                                        "tpucheck_baseline.json"))
+    assert bl.entries, "ledger should carry the reviewed exceptions"
+    for e in bl.entries:
+        assert len(e["why"]) > 20, f"thin justification: {e}"

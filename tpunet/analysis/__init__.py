@@ -1,0 +1,46 @@
+"""tpucheck — repo-native JAX/TPU static analysis.
+
+The correctness-tooling leg next to the perf and observability legs:
+an AST-based checker whose rules encode this repo's own failure
+history as machine-enforced invariants, so the bug classes that cost
+whole debugging rounds can't ship twice:
+
+- **R1 donation-aliasing** — IO-origin arrays (orbax restore, np
+  loads, dlpack/ctypes views) passed into ``donate_argnums`` jitted
+  callables without re-materialization: the exact PR-7 resume
+  heap-corruption class (root-caused with the flight recorder after
+  three rounds of misattribution to the native prefetcher).
+- **R2 named-scope coverage** — every Pallas kernel call and
+  custom_vjp fwd/bwd body in ``tpunet/ops/`` must sit under a
+  ``tpunet_*`` named scope that ``tpunet/obs/hlo_bytes.py``'s marker
+  table knows, so byte/phase attribution can't silently rot (the
+  PR-6 scope-misattribution class).
+- **R3 host side-effects inside jit** — ``print`` / ``time.*`` /
+  global mutation / numpy ops on traced values inside
+  jit/shard_map/pallas bodies (they run once at trace time, then
+  silently never again).
+- **R4 thread-registry enforcement** — every ``threading.Thread`` /
+  ``subprocess.Popen`` spawn in ``tpunet/`` registers with the
+  flightrec ``THREADS`` registry (PR-7's host-thread inventory) or is
+  explicitly allowlisted: an unregistered thread is invisible to
+  crash forensics and the ``thread_stalled`` watchdog.
+- **R5 config/CLI/docs drift** — every ``ObsConfig`` / ``ModelConfig``
+  / ``ServeConfig`` field has a wired CLI flag and a docs mention.
+
+Run ``python -m tpunet.analysis`` (or ``scripts/tpucheck.py``).
+Accepted findings live in ``docs/tpucheck_baseline.json`` with a
+one-line justification each; line-level escapes use
+``# tpucheck: disable=R3`` comments. docs/static_analysis.md is the
+full catalog.
+"""
+
+from __future__ import annotations
+
+from tpunet.analysis.baseline import Baseline
+from tpunet.analysis.core import Finding, Project, Rule, run_rules
+from tpunet.analysis.rules import ALL_RULES, rules_by_id
+
+__all__ = [
+    "ALL_RULES", "Baseline", "Finding", "Project", "Rule",
+    "run_rules", "rules_by_id",
+]

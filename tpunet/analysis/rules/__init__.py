@@ -1,0 +1,24 @@
+"""tpucheck rule registry."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from tpunet.analysis.core import Rule
+from tpunet.analysis.rules.donation import DonationRule
+from tpunet.analysis.rules.drift import DriftRule
+from tpunet.analysis.rules.jit_effects import JitEffectsRule
+from tpunet.analysis.rules.scopes import ScopeRule
+from tpunet.analysis.rules.threads import ThreadRule
+
+ALL_RULES: Tuple[Rule, ...] = (
+    DonationRule(),
+    ScopeRule(),
+    JitEffectsRule(),
+    ThreadRule(),
+    DriftRule(),
+)
+
+
+def rules_by_id() -> Dict[str, Rule]:
+    return {r.id: r for r in ALL_RULES}

@@ -1,0 +1,327 @@
+"""R1 — donation-aliasing: IO-origin arrays into donated jit args.
+
+The PR-7 resume heap corruption in one rule: ``donate_argnums`` tells
+XLA it may free/reuse an argument's buffers the moment the call is
+dispatched — safe for device arrays the caller truly abandons, but an
+array that *aliases host memory something else still owns* (an orbax
+restore's mmap, a ``np.asarray`` view over a ctypes/dlpack buffer, a
+file load) gets its backing store handed to the allocator while the
+real owner still writes through it. glibc aborts a few dispatches
+later, nowhere near the cause; it took a flight recorder and ten
+reproductions to attribute. The checker attributes it at review time:
+
+- **donated callables**: ``X = jax.jit(f, donate_argnums=...)`` (or
+  ``donate_argnames``), including ``self.X = ...`` method slots;
+- **IO-origin taint**: values returned by restore/load-like calls
+  (``*.restore*``, ``np.load``, ``np.asarray``, ``np.frombuffer``,
+  ``np.fromfile``, ``np.memmap``, ``pickle.load``, ``from_dlpack``,
+  ``ctypeslib.as_array``), propagated through subscripts, attribute
+  stores, tuples, and conditionals;
+- **re-materialization** clears taint: ``jnp.copy`` / ``jnp.array`` /
+  ``jnp.asarray`` / ``jax.device_put``, alone or as the mapped
+  function of a ``tree_map``.
+
+A call passing a tainted value in a donated position is the finding.
+
+Precision notes (documented approximations, tuned for this repo's
+idioms): module and function bodies are analyzed in order with
+reassignment clearing taint; class bodies are analyzed
+flow-insensitively over ``self.*`` (methods run in arbitrary order at
+runtime — ``_try_resume`` taints ``self.state`` long after ``train``
+was defined), so a ``self`` attribute that is *ever* IO-tainted stays
+tainted for every donated call in the class. Calls into other modules
+are opaque: a function whose *name* looks restore-like taints its
+result even if its body re-materializes — that is what the baseline
+ledger (with its one-line justification) is for.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tpunet.analysis.core import (Finding, Project, Rule, SourceFile,
+                                  call_name)
+
+_IO_NAME_RE = re.compile(
+    r"(^|_)(restore|load|loads|frombuffer|fromfile|memmap|from_dlpack"
+    r"|as_array|unpack)(_|$)|^asarray$", re.IGNORECASE)
+
+# Re-materialization wrappers: dotted-name suffixes whose result owns
+# fresh device (or at least fresh) buffers.
+_SAFE_SUFFIXES = (
+    "jnp.copy", "jnp.array", "jnp.asarray", "numpy.copy", "numpy.array",
+    "numpy.asarray", "jax.device_put", "device_put",
+)
+
+_TREE_MAP_SUFFIXES = ("tree_map", "tree.map")
+
+_JIT_SUFFIXES = (".jit", ".pjit")
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    return bool(name) and (name == "jit" or name == "pjit"
+                           or name.endswith(_JIT_SUFFIXES))
+
+
+def _donated_spec(node: ast.Call
+                  ) -> Optional[Tuple[Tuple[int, ...], Tuple[str, ...]]]:
+    """(donated positions, donated argnames) when this is a jit call
+    with donation, else None."""
+    if not _is_jit_call(node):
+        return None
+    positions: List[int] = []
+    names: List[str] = []
+    found = False
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            found = True
+            positions.extend(_int_list(kw.value))
+        elif kw.arg == "donate_argnames":
+            found = True
+            names.extend(_str_list(kw.value))
+    if not found:
+        return None
+    return tuple(positions), tuple(names)
+
+
+def _int_list(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return out
+    return []
+
+
+def _str_list(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [elt.value for elt in node.elts
+                if isinstance(elt, ast.Constant)
+                and isinstance(elt.value, str)]
+    return []
+
+
+def _target_name(node: ast.AST) -> Optional[str]:
+    """'x' for Name targets, 'self.x' for self-attribute targets."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return None
+
+
+def _expr_ref(node: ast.AST) -> Optional[str]:
+    """The tracked name an expression is rooted at ('x', 'self.x'),
+    looking through subscripts/attribute reads."""
+    cur = node
+    while True:
+        name = _target_name(cur)
+        if name is not None:
+            return name
+        if isinstance(cur, ast.Subscript):
+            cur = cur.value
+            continue
+        if isinstance(cur, ast.Attribute):
+            cur = cur.value
+            continue
+        return None
+
+
+class _Analyzer:
+    """Taint/donation bookkeeping over one scope unit (module body,
+    function body, or class)."""
+
+    def __init__(self, src: SourceFile, findings: List[Finding],
+                 flow_sensitive: bool) -> None:
+        self.src = src
+        self.findings = findings
+        self.flow_sensitive = flow_sensitive
+        self.donated: Dict[str, Tuple[Tuple[int, ...],
+                                      Tuple[str, ...]]] = {}
+        self.tainted: Dict[str, Tuple[str, int]] = {}  # name -> (origin, line)
+
+    # -- taint classification ------------------------------------------
+
+    def is_io_call(self, node: ast.Call) -> bool:
+        name = call_name(node)
+        if not name:
+            return False
+        last = name.rsplit(".", 1)[-1]
+        if name.endswith(_SAFE_SUFFIXES):
+            # np.asarray is BOTH: a copy for device arrays but a view
+            # over buffer-protocol objects (the dlpack/ctypes path).
+            # Treat it as IO-origin when fed an already-tainted or
+            # non-trivial buffer expression, safe when re-wrapping.
+            if last == "asarray" and node.args \
+                    and self._tainted_expr(node.args[0]) is None:
+                return False
+            if last != "asarray":
+                return False
+        return bool(_IO_NAME_RE.search(last))
+
+    def is_safe_wrapper(self, node: ast.Call) -> bool:
+        name = call_name(node)
+        if not name:
+            return False
+        if name.endswith(_TREE_MAP_SUFFIXES) and node.args:
+            mapped = node.args[0]
+            if isinstance(mapped, ast.Call):
+                return False
+            mapped_name = ""
+            if isinstance(mapped, (ast.Name, ast.Attribute)):
+                from tpunet.analysis.core import dotted
+                mapped_name = dotted(mapped)
+            return mapped_name.endswith(_SAFE_SUFFIXES)
+        if name.endswith(("jnp.asarray", "numpy.asarray")) \
+                or name.rsplit(".", 1)[-1] == "asarray":
+            # asarray of a tainted host view is a no-copy alias, not a
+            # re-materialization.
+            return False
+        return name.endswith(_SAFE_SUFFIXES)
+
+    def _tainted_expr(self, node: ast.AST) -> Optional[Tuple[str, int]]:
+        """(origin, line) when the expression carries IO taint."""
+        if isinstance(node, ast.Call):
+            if self.is_safe_wrapper(node):
+                return None
+            if self.is_io_call(node):
+                return (call_name(node), node.lineno)
+            return None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                t = self._tainted_expr(elt)
+                if t:
+                    return t
+            return None
+        if isinstance(node, ast.Dict):
+            for v in node.values:
+                if v is not None:
+                    t = self._tainted_expr(v)
+                    if t:
+                        return t
+            return None
+        if isinstance(node, ast.IfExp):
+            return (self._tainted_expr(node.body)
+                    or self._tainted_expr(node.orelse))
+        ref = _expr_ref(node)
+        if ref is not None and ref in self.tainted:
+            return self.tainted[ref]
+        return None
+
+    # -- statement processing ------------------------------------------
+
+    def handle_assign(self, node: ast.Assign) -> None:
+        targets = []
+        for t in node.targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+            else:
+                targets.append(t)
+        names = [_target_name(t) for t in targets]
+        spec = (_donated_spec(node.value)
+                if isinstance(node.value, ast.Call) else None)
+        taint = self._tainted_expr(node.value)
+        for name in names:
+            if name is None:
+                continue
+            if spec is not None:
+                self.donated[name] = spec
+                self.tainted.pop(name, None)
+            elif taint is not None:
+                self.tainted[name] = taint
+            else:
+                self.donated.pop(name, None)
+                if self.flow_sensitive:
+                    self.tainted.pop(name, None)
+
+    def handle_call_site(self, node: ast.Call) -> None:
+        from tpunet.analysis.core import dotted
+        callee = dotted(node.func)
+        if not callee or callee not in self.donated:
+            return
+        positions, argnames = self.donated[callee]
+        checks: List[Tuple[str, ast.AST]] = []
+        for pos in positions:
+            if pos < len(node.args):
+                checks.append((f"arg {pos}", node.args[pos]))
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg in argnames:
+                checks.append((f"arg '{kw.arg}'", kw.value))
+        for label, arg in checks:
+            taint = self._tainted_expr(arg)
+            if taint is None:
+                continue
+            origin, origin_line = taint
+            arg_src = ast.unparse(arg) if hasattr(ast, "unparse") else "?"
+            self.findings.append(Finding(
+                rule="R1", path=self.src.rel, line=node.lineno,
+                message=(f"IO-origin value '{arg_src}' (tainted via "
+                         f"'{origin}' at line {origin_line}) is passed "
+                         f"as donated {label} of '{callee}' — donation "
+                         "frees buffers that may alias host memory the "
+                         "producer still owns (the PR-7 resume "
+                         "heap-corruption class)"),
+                hint=("re-materialize before donating: x = jax.tree_util"
+                      ".tree_map(jnp.copy, restored) or jax.device_put("
+                      "x); if the producer already re-materializes, "
+                      "record that in docs/tpucheck_baseline.json"),
+                key=f"donate:{callee}<-{arg_src}"))
+
+    def scan_statements(self, stmts: Sequence[ast.stmt],
+                        passes: int = 1) -> None:
+        """Process assignments and call sites. With ``passes=2`` the
+        first pass only collects donation/taint facts (flow-insensitive
+        class analysis); the last pass reports call sites."""
+        for is_last in ([True] if passes == 1 else [False, True]):
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Assign):
+                        self.handle_assign(node)
+                    elif isinstance(node, ast.AnnAssign) \
+                            and node.value is not None:
+                        self.handle_assign(ast.Assign(
+                            targets=[node.target], value=node.value,
+                            lineno=node.lineno))
+                    elif isinstance(node, ast.Call) and is_last:
+                        self.handle_call_site(node)
+
+
+class DonationRule(Rule):
+    id = "R1"
+    name = "donation-aliasing"
+    doc = ("IO-origin arrays (orbax restore, np loads, dlpack/ctypes "
+           "views) passed into donate_argnums/donate_argnames jitted "
+           "callables without re-materialization")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.files():
+            if src.tree is None:
+                continue
+            assert isinstance(src.tree, ast.Module)
+            module_stmts: List[ast.stmt] = []
+            for stmt in src.tree.body:
+                if isinstance(stmt, ast.ClassDef):
+                    # Class unit: methods share the self.* namespace;
+                    # two-pass flow-insensitive (see module docstring).
+                    _Analyzer(src, findings, flow_sensitive=False) \
+                        .scan_statements(stmt.body, passes=2)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    _Analyzer(src, findings, flow_sensitive=True) \
+                        .scan_statements(stmt.body)
+                else:
+                    module_stmts.append(stmt)
+            _Analyzer(src, findings, flow_sensitive=True) \
+                .scan_statements(module_stmts)
+        return findings

@@ -607,6 +607,14 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--vit-hidden", type=int, default=None)
     p.add_argument("--vit-depth", type=int, default=None)
     p.add_argument("--vit-heads", type=int, default=None)
+    p.add_argument("--vit-mlp-ratio", type=float, default=None,
+                   help="ViT MLP hidden width as a multiple of the "
+                        "embedding width (default 4.0)")
+    p.add_argument("--param-dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="parameter/optimizer-state storage dtype "
+                        "(default float32 master params; --dtype "
+                        "stays the compute dtype)")
     p.add_argument("--width-mult", type=float, default=None)
     p.add_argument("--synthetic-size", type=int, default=None,
                    help="train-set size when --dataset synthetic")
@@ -671,6 +679,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--obs-queue-size", type=int, default=None,
                    help="bounded export queue depth (overflow drops "
                         "records and counts them, never blocks a step)")
+    p.add_argument("--obs-hist-samples", type=int, default=None,
+                   help="histogram reservoir bound "
+                        "(histogram_max_samples): windows beyond this "
+                        "many observations switch from exact "
+                        "percentiles to seeded reservoir sampling")
+    p.add_argument("--alert-cooldown-steps", type=int, default=None,
+                   help="suppress same-reason obs_alerts within this "
+                        "many steps (counted in obs_alerts_suppressed) "
+                        "so a stall pages once")
     p.add_argument("--halt-on-unhealthy", action="store_true",
                    help="abort the run (RunUnhealthyError) on a fatal "
                         "obs_alert: step stall, NaN/spiking loss, or "
@@ -785,7 +802,11 @@ def config_from_args(argv=None) -> TrainConfig:
                            ("stall_min_s", args.stall_min_s),
                            ("loss_spike_factor", args.loss_spike_factor),
                            ("heartbeat_timeout_s",
-                            args.heartbeat_timeout)):
+                            args.heartbeat_timeout),
+                           ("histogram_max_samples",
+                            args.obs_hist_samples),
+                           ("alert_cooldown_steps",
+                            args.alert_cooldown_steps)):
         if arg is not None:
             obs = dataclasses.replace(obs, **{obs_field: arg})
     if args.batch_size is not None:
@@ -842,6 +863,7 @@ def config_from_args(argv=None) -> TrainConfig:
     if args.grad_accum is not None:
         optim = dataclasses.replace(optim, grad_accum=args.grad_accum)
     for name in ("vit_patch", "vit_hidden", "vit_depth", "vit_heads",
+                 "vit_mlp_ratio", "param_dtype",
                  "moe_experts", "moe_top_k", "moe_every",
                  "moe_capacity_factor", "moe_aux_weight", "moe_dispatch",
                  "vocab_ce", "pp_microbatches", "pp_schedule",

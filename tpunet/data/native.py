@@ -24,7 +24,14 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
 _SRC = os.path.join(_REPO, "cxx", "batcher.cc")
 _LIB_DIR = os.path.join(_HERE, "_lib")
-_LIB = os.path.join(_LIB_DIR, "libtnbatcher.so")
+# TPUNET_NATIVE_LIB points the bindings at an alternative build of the
+# same source — the sanitizer variants (``make -C cxx asan|tsan|ubsan``,
+# driven by scripts/check_sanitizers.py with the matching runtime
+# LD_PRELOADed). An override is used as-is: never auto-(re)built, and
+# required to exist (a sanitizer gate that silently fell back to the
+# plain library would pass without testing anything).
+_LIB_OVERRIDE = os.environ.get("TPUNET_NATIVE_LIB", "")
+_LIB = _LIB_OVERRIDE or os.path.join(_LIB_DIR, "libtnbatcher.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -72,7 +79,11 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if (not os.path.exists(_LIB) or _stale()) and not _build():
+        if _LIB_OVERRIDE:
+            if not os.path.exists(_LIB):
+                _load_failed = True
+                return None
+        elif (not os.path.exists(_LIB) or _stale()) and not _build():
             if not os.path.exists(_LIB):
                 _load_failed = True
                 return None
@@ -141,7 +152,14 @@ def journal_entries(max_entries: int = 256) -> list:
         return []
     buf = (_JournalEntry * max_entries)()
     n = lib.tn_journal_read(buf, max_entries)
-    from tpunet.obs.flightrec.report import NATIVE_OPS
+    try:
+        # Op-id -> name table from the flight recorder. Optional: this
+        # module (and the jax-free sanitizer stress driver that loads
+        # it by file path) must work without the obs stack — raw
+        # ``opN`` names then.
+        from tpunet.obs.flightrec.report import NATIVE_OPS
+    except Exception:
+        NATIVE_OPS = {}
     return [{"seq": int(e.seq),
              "op": NATIVE_OPS.get(int(e.op), f"op{int(e.op)}"),
              "tid": int(e.tid), "a": int(e.a), "b": int(e.b)}

@@ -26,7 +26,7 @@ shutdown. ``tpunet/obs/__init__.py`` wires it to the run lifecycle;
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from tpunet.obs.flightrec.crash import (FlightRecorder, crash_record,
                                         prior_crash_report)
@@ -43,7 +43,7 @@ __all__ = [
 _REC: Optional[FlightRecorder] = None
 
 
-def install(directory: str, **kw) -> FlightRecorder:
+def install(directory: str, **kw: object) -> FlightRecorder:
     """Arm the process-global recorder (closing any previous one —
     crash handlers and the watcher are process-wide, so the newest
     run dir wins)."""
@@ -68,7 +68,8 @@ def record(kind: str, msg: str = "") -> None:
 
 
 def register_thread(name: str, stall_after_s: float = 0.0,
-                    clock=None) -> ThreadHandle:
+                    clock: Optional[Callable[[], float]] = None
+                    ) -> ThreadHandle:
     """Register a background thread in the process-global registry
     (convenience over ``THREADS.register``)."""
     import time

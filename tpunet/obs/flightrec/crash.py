@@ -33,7 +33,7 @@ import os
 import subprocess
 import sys
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 from tpunet.obs.flightrec import report as _report
 from tpunet.obs.flightrec.ring import DEFAULT_SLOTS, EventRing
@@ -199,7 +199,7 @@ class FlightRecorder:
         if self.ring is not None and not self._closed:
             self.ring.record(kind, msg)
 
-    def set_device_memory(self, mem) -> None:
+    def set_device_memory(self, mem: Optional[dict]) -> None:
         """Refresh the last-known device ``memory_stats()`` snapshot
         (epoch boundaries). Crash handlers cannot query a device, so
         the report carries the most recent sample."""
@@ -228,7 +228,8 @@ class FlightRecorder:
 # -- prior-crash detection ----------------------------------------------
 
 
-def prior_crash_report(directory: str, process_index: int = 0):
+def prior_crash_report(directory: str, process_index: int = 0
+                       ) -> Tuple[Optional[dict], Optional[str]]:
     """(report dict, archived path) when the previous incarnation of
     this run dir left a crash report; (None, None) otherwise. The
     report file is archived (renamed with its mtime) so one crash

@@ -137,7 +137,7 @@ def assemble(directory: str, process_index: int = 0,
     """Build the crash report dict from whatever artifacts the dead
     process left behind. Every section is best-effort: a report with
     holes beats no report."""
-    def p(name):
+    def p(name: str) -> str:
         return artifact(directory, name, process_index)
 
     stacks = parse_stacks(_read_text(p(STACKS_TXT)))

@@ -107,7 +107,7 @@ def _decode(raw: bytes) -> str:
     return raw.split(b"\x00", 1)[0].decode("utf-8", "replace")
 
 
-def read_slots(buf, n: int = 0) -> List[dict]:
+def read_slots(buf: "bytes | mmap.mmap", n: int = 0) -> List[dict]:
     """Parse ring slots out of any buffer laid out by ``EventRing``
     (live mmap or a post-mortem file read). Torn/garbage slots are
     tolerated; unwritten ones (seq 0) are dropped."""

@@ -28,7 +28,7 @@ from __future__ import annotations
 import re
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 IDLE = "idle"
 BUSY = "busy"
@@ -39,7 +39,7 @@ class ThreadHandle:
                  "ident", "started_t", "_clock")
 
     def __init__(self, name: str, stall_after_s: float = 0.0,
-                 clock=time.monotonic):
+                 clock: Callable[[], float] = time.monotonic):
         self.name = name
         self.stall_after_s = float(stall_after_s)
         self._clock = clock
@@ -85,7 +85,8 @@ class ThreadRegistry:
         self._lock = threading.Lock()
 
     def register(self, name: str, stall_after_s: float = 0.0,
-                 clock=time.monotonic) -> ThreadHandle:
+                 clock: Callable[[], float] = time.monotonic
+                 ) -> ThreadHandle:
         handle = ThreadHandle(name, stall_after_s, clock)
         with self._lock:
             self._handles[name] = handle
@@ -109,7 +110,7 @@ class ThreadRegistry:
                 out.append((h, h.age_s(now)))
         return out
 
-    def export_gauges(self, registry) -> None:
+    def export_gauges(self, registry: object) -> None:
         """Mirror the registry into ``thread_*`` gauges on an obs
         Registry (docs/metrics_schema.md "Registry snapshot keys"):
         ``thread_count`` plus per-thread ``thread_<name>_age_s`` /

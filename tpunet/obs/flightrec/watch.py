@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import os
 import sys
+from typing import Optional
 
 if __package__:
     from tpunet.obs.flightrec import report as _report
@@ -54,7 +55,7 @@ def _owned(current: str, pidx: int, pid: int) -> bool:
     return meta.get("pid") in (None, pid)
 
 
-def main(stdin=None) -> int:
+def main(stdin: Optional[object] = None) -> int:
     stdin = stdin if stdin is not None else sys.stdin
     current = ""
     pidx = 0

@@ -87,6 +87,40 @@ CATEGORIES = ("conv_fwd", "conv_bwd", "matmul", "bn", "augment",
               "optimizer", "copy_pad", "reduce", "collective",
               "elementwise")
 
+# ---------------------------------------------------------------------------
+# Named-scope marker table — THE contract between the kernels in
+# tpunet/ops/ and byte/phase attribution. Each custom_vjp'd Pallas
+# kernel pair lowers to custom calls (no convolution/dot opcode, no
+# ``transpose(`` marker on the custom_vjp backward), so the ONLY thing
+# keeping its bytes in the right bucket and its backward in the bwd
+# phase is the ``tpunet_<kernel>_{fwd,bwd}`` named scope around the
+# kernel body. tpucheck rule R2 (tpunet/analysis/rules/scopes.py)
+# imports this table and fails the tree when a kernel in tpunet/ops/
+# is missing its scope or uses one this table doesn't know — so the
+# attribution can't silently rot (the PR-6 failure class).
+# ---------------------------------------------------------------------------
+
+# Kernel scope prefix -> (forward category, backward category). The
+# scope in the code must be exactly ``<prefix>_fwd`` / ``<prefix>_bwd``.
+KERNEL_SCOPES: Dict[str, Tuple[str, str]] = {
+    "tpunet_fused_ir": ("conv_fwd", "conv_bwd"),
+    "tpunet_depthwise": ("conv_fwd", "conv_bwd"),
+    # Flash attention is MXU matmul work; without the marker its
+    # custom calls land in ``elementwise`` and its custom_vjp backward
+    # (no ``transpose(`` scope) would misattribute to the fwd phase.
+    "tpunet_flash": ("matmul", "matmul"),
+}
+
+# Scopes that mark a training phase directly (train/steps.py et al.).
+PHASE_MARKERS: Tuple[Tuple[str, str], ...] = (
+    ("tpunet_optimizer", "optimizer"),
+    ("tpunet_ema", "ema"),
+    ("tpunet_eval_forward", "eval"),
+    ("tpunet_augment", "augment"),
+)
+
+_BWD_MARKERS = tuple(f"{p}_bwd" for p in KERNEL_SCOPES)
+
 
 def _shape_bytes(type_str: str) -> int:
     """Total bytes of an HLO type string (tuples sum their elements)."""
@@ -110,8 +144,8 @@ def is_backward(op_name: str) -> bool:
     backwards, whose ops a custom-vjp rule does not nest under
     ``transpose(``)."""
     name = op_name or ""
-    return ("transpose(" in name or "tpunet_fused_ir_bwd" in name
-            or "tpunet_depthwise_bwd" in name)
+    return ("transpose(" in name
+            or any(m in name for m in _BWD_MARKERS))
 
 
 def phase_of(op_name: str) -> str:
@@ -119,14 +153,9 @@ def phase_of(op_name: str) -> str:
     ema / eval / other — the split scripts/obs_report.py reports
     device time under."""
     name = op_name or ""
-    if "tpunet_optimizer" in name:
-        return "optimizer"
-    if "tpunet_ema" in name:
-        return "ema"
-    if "tpunet_eval_forward" in name:
-        return "eval"
-    if "tpunet_augment" in name:
-        return "augment"
+    for marker, phase in PHASE_MARKERS:
+        if marker in name:
+            return phase
     if "tpunet_fwd_bwd" in name or "jvp(" in name:
         return "bwd" if is_backward(name) else "fwd"
     return "other"
@@ -147,14 +176,14 @@ def categorize(opcode: str, op_name: str) -> str:
         # Before the conv/dot checks: the rotation's shear matmul
         # banks are dots, but they are input-pipeline work.
         return "augment"
-    if "tpunet_fused_ir" in name or "tpunet_depthwise" in name:
-        # The fused inverted-residual and depthwise Pallas kernels
-        # lower to custom calls, not convolution opcodes; their
-        # explicit fwd/bwd scopes keep them in the conv buckets the
-        # budget gates. (The tpunet_ prefix keeps the match off the
-        # model's '/depthwise/' module path, whose XLA convs the
-        # opcode branch below already handles.)
-        return "conv_bwd" if is_backward(name) else "conv_fwd"
+    for prefix, (fwd_cat, bwd_cat) in KERNEL_SCOPES.items():
+        # The custom_vjp'd Pallas kernels lower to custom calls, not
+        # convolution/dot opcodes; their explicit fwd/bwd scopes keep
+        # them in the buckets the budget gates. (The tpunet_ prefix
+        # keeps the match off the model's '/depthwise/' module path,
+        # whose XLA convs the opcode branch below already handles.)
+        if prefix in name:
+            return bwd_cat if is_backward(name) else fwd_cat
     leaf = _leaf_primitive(name)
     if opcode == "convolution" or "conv_general_dilated" in leaf:
         return "conv_bwd" if is_backward(name) else "conv_fwd"

@@ -310,19 +310,23 @@ def _forward_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     o_shape = jax.ShapeDtypeStruct((b, h, tq, d), q.dtype)
     lse_spec = pl.BlockSpec((1, 1, bq, 128), qmap)
     lse_shape = jax.ShapeDtypeStruct((b, h, tq, 128), jnp.float32)
-    res = pl.pallas_call(
-        kern,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=[o_spec, lse_spec] if with_lse else o_spec,
-        out_shape=[o_shape, lse_shape] if with_lse else o_shape,
-        scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),    # running max m
-            pltpu.VMEM((bq, 128), jnp.float32),    # running normalizer l
-            pltpu.VMEM((bq, d), jnp.float32),      # un-normalized acc
-        ],
-        interpret=interpret,
-    )(*args)
+    # Named for byte/phase attribution (tpunet/obs/hlo_bytes.py
+    # KERNEL_SCOPES): the kernel lowers to a custom call, not a dot
+    # opcode, so the scope is what keeps it in the matmul bucket.
+    with jax.named_scope("tpunet_flash_fwd"):
+        res = pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[o_spec, lse_spec] if with_lse else o_spec,
+            out_shape=[o_shape, lse_shape] if with_lse else o_shape,
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),    # running max m
+                pltpu.VMEM((bq, 128), jnp.float32),    # running normalizer l
+                pltpu.VMEM((bq, d), jnp.float32),      # un-normalized acc
+            ],
+            interpret=interpret,
+        )(*args)
     if with_lse:
         out, lse = res
         # out back to BTHD; lse squeezed to [B, H, Tq] (the kernel
@@ -519,19 +523,23 @@ def _pallas_backward(q, k, v, out, lse, do,
     kv_spec = pl.BlockSpec((1, 1, bk, d), kvmap)
     seg_specs = [pl.BlockSpec((1, bq, 128), qsegmap),
                  pl.BlockSpec((1, 8, bk), ksegmap)] if with_seg else []
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, tq=tq, tk=tk,
-                          with_glse=with_glse, tri=tri,
-                          with_segments=with_seg),
-        grid=grid_dq,
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec]
-        + [row_spec] * len(rows) + seg_specs,
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=interpret,
-    )(qt, kt, vt, dot_, *rows, *segs)
+    # Scoped like the fused-IR/depthwise backwards: a custom_vjp
+    # backward carries no transpose( marker, so the tpunet_flash_bwd
+    # scope is what keeps these kernels in the bwd phase.
+    with jax.named_scope("tpunet_flash_bwd"):
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, causal=causal,
+                              bq=bq, bk=bk, nk=nk, tq=tq, tk=tk,
+                              with_glse=with_glse, tri=tri,
+                              with_segments=with_seg),
+            grid=grid_dq,
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec]
+            + [row_spec] * len(rows) + seg_specs,
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            interpret=interpret,
+        )(qt, kt, vt, dot_, *rows, *segs)
 
     # dK/dV: same block roles, transposed order — k block index is the
     # grid row, q block the accumulated axis (the upper triangle when
@@ -543,21 +551,22 @@ def _pallas_backward(q, k, v, out, lse, do,
     kvj_spec = pl.BlockSpec((1, 1, bk, d), kvmap_t)
     segi_specs = [pl.BlockSpec((1, bq, 128), qsegmap_t),
                   pl.BlockSpec((1, 8, bk), ksegmap_t)] if with_seg else []
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, tq=tq, tk=tk,
-                          with_glse=with_glse, with_segments=with_seg,
-                          tri=tri),
-        grid=grid_dkv,
-        in_specs=[qi_spec, kvj_spec, kvj_spec, qi_spec]
-        + [rowi_spec] * len(rows) + segi_specs,
-        out_specs=[kvj_spec, kvj_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
-        interpret=interpret,
-    )(qt, kt, vt, dot_, *rows, *segs)
+    with jax.named_scope("tpunet_flash_bwd"):
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                              bq=bq, bk=bk, nq=nq, tq=tq, tk=tk,
+                              with_glse=with_glse, with_segments=with_seg,
+                              tri=tri),
+            grid=grid_dkv,
+            in_specs=[qi_spec, kvj_spec, kvj_spec, qi_spec]
+            + [rowi_spec] * len(rows) + segi_specs,
+            out_specs=[kvj_spec, kvj_spec],
+            out_shape=[jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
+                       jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((bk, d), jnp.float32)],
+            interpret=interpret,
+        )(qt, kt, vt, dot_, *rows, *segs)
     return (dq.swapaxes(1, 2), dk.swapaxes(1, 2), dv.swapaxes(1, 2))
 
 
@@ -694,15 +703,21 @@ def _make_flash(fwd_prim, res_prim, bwd_prim):
                         interpret)
 
     def fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-        out, lse = res_prim(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+        # Scopes on the custom_vjp bodies keep EVERYTHING they emit
+        # (lane broadcasts, delta reductions, swapaxes copies — not
+        # just the kernels) attributed to the right phase/bucket
+        # (tpunet/obs/hlo_bytes.py KERNEL_SCOPES).
+        with jax.named_scope("tpunet_flash_fwd"):
+            out, lse = res_prim(q, k, v, causal, scale, block_q,
+                                block_k, interpret)
         return out, (q, k, v, out, lse)
 
     def bwd(causal, scale, block_q, block_k, interpret, res, g):
         q, k, v, out, lse = res
         # Plain attention exposes no lse downstream: no glse operand.
-        return bwd_prim(q, k, v, out, lse, g, causal, scale, block_q,
-                        block_k, interpret)
+        with jax.named_scope("tpunet_flash_bwd"):
+            return bwd_prim(q, k, v, out, lse, g, causal, scale,
+                            block_q, block_k, interpret)
 
     f.defvjp(fwd, bwd)
     return f
@@ -834,14 +849,17 @@ def _make_flash_seg(fwd_prim, res_prim, bwd_prim):
 
     def fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k,
             interpret):
-        out, lse = res_prim(q, k, v, qseg, kseg, causal, scale, block_q,
-                            block_k, interpret)
+        with jax.named_scope("tpunet_flash_fwd"):
+            out, lse = res_prim(q, k, v, qseg, kseg, causal, scale,
+                                block_q, block_k, interpret)
         return out, (q, k, v, qseg, kseg, out, lse)
 
     def bwd(causal, scale, block_q, block_k, interpret, res, g):
         q, k, v, qseg, kseg, out, lse = res
-        dq, dk, dv = bwd_prim(q, k, v, qseg, kseg, out, lse, g, causal,
-                              scale, block_q, block_k, interpret)
+        with jax.named_scope("tpunet_flash_bwd"):
+            dq, dk, dv = bwd_prim(q, k, v, qseg, kseg, out, lse, g,
+                                  causal, scale, block_q, block_k,
+                                  interpret)
         z = lambda a: np.zeros(a.shape, jax.dtypes.float0)
         return dq, dk, dv, z(qseg), z(kseg)
 
@@ -883,8 +901,9 @@ def _flash_local_state(q, k, v, causal, scale, block_q, block_k,
 
 
 def _fwd_local_state(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _pallas_forward_res(q, k, v, causal, scale, block_q,
-                                   block_k, interpret)
+    with jax.named_scope("tpunet_flash_fwd"):
+        out, lse = _pallas_forward_res(q, k, v, causal, scale, block_q,
+                                       block_k, interpret)
     return (out, lse), (q, k, v, out, lse)
 
 
@@ -894,8 +913,9 @@ def _bwd_local_state(causal, scale, block_q, block_k, interpret, res, g):
     # The lse output IS consumed downstream (the ring's state-merge
     # weights depend on it), so its cotangent carries real gradient:
     # d lse / d s = p, folded into ds inside the kernels.
-    return _pallas_backward(q, k, v, out, lse, go, causal, scale,
-                            block_q, block_k, interpret, glse=glse)
+    with jax.named_scope("tpunet_flash_bwd"):
+        return _pallas_backward(q, k, v, out, lse, go, causal, scale,
+                                block_q, block_k, interpret, glse=glse)
 
 
 _flash_local_state.defvjp(_fwd_local_state, _bwd_local_state)

@@ -42,6 +42,10 @@ def build_argparser():
     p.add_argument("--max-new-tokens", type=int,
                    default=d.default_max_new_tokens,
                    help="default per-request generation budget")
+    p.add_argument("--max-new-tokens-cap", type=int,
+                   default=d.max_new_tokens_cap,
+                   help="hard per-request generation ceiling: larger "
+                        "asks are clamped to it at admission")
     p.add_argument("--deadline-s", type=float,
                    default=d.default_deadline_s,
                    help="default per-request wall-clock deadline "
@@ -112,6 +116,7 @@ def build_server(args):
         host=args.host, port=args.port, slots=args.slots,
         queue_max=args.queue_max, prefill_buckets=buckets,
         default_max_new_tokens=args.max_new_tokens,
+        max_new_tokens_cap=args.max_new_tokens_cap,
         default_deadline_s=args.deadline_s,
         classify_batch_max=args.classify_batch_max,
         classify_window_ms=args.classify_window_ms,

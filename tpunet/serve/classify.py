@@ -69,6 +69,12 @@ class ClassifyBatcher:
 
         self._forward = jax.jit(forward)
         self._jnp = jnp
+        # Host-thread registry (tpunet/obs/flightrec/): a batched
+        # forward wedged on the device past the budget pages
+        # thread_stalled; idle queue waits do not (tpucheck R4).
+        from tpunet.obs import flightrec
+        self._thread_handle = flightrec.register_thread(
+            "serve-classify", stall_after_s=120.0)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="tpunet-serve-classify")
         self._thread.start()
@@ -125,6 +131,7 @@ class ClassifyBatcher:
                 except queue.Empty:
                     break
             t0 = time.perf_counter()
+            self._thread_handle.beat("busy")
             try:
                 x = np.zeros((self.batch_max, self._size, self._size, 3),
                              np.float32)
@@ -140,6 +147,7 @@ class ClassifyBatcher:
                 for item in batch:
                     item.error = f"{type(e).__name__}: {e}"
                     item.event.set()
+            self._thread_handle.beat("idle")
             reg.counter("serve_classify_requests_total").inc(len(batch))
             reg.counter("serve_classify_batches_total").inc()
             reg.histogram("serve_classify_batch_size").observe(len(batch))

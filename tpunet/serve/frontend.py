@@ -87,6 +87,13 @@ class ServeServer:
 
     def start(self) -> "ServeServer":
         self.engine.start()
+        # Host-thread registry (tpunet/obs/flightrec/, tpucheck R4):
+        # inventory-only (stall budget 0 — serve_forever blocks in
+        # accept(), so it cannot beat; liveness is the /healthz
+        # contract, but the thread must still show up in crash
+        # reports and thread_* gauges).
+        from tpunet.obs import flightrec
+        flightrec.register_thread("serve-http")
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True,
             name="tpunet-serve-http")
