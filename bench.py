@@ -171,6 +171,12 @@ def _measure(per_chip_batch: int, timed: int = 24, image_size: int = 224,
     )
     ds = synthetic_cifar10(n_train=4 * batch, n_test=batch)
     trainer = Trainer(cfg, dataset=ds)
+    # Identity stamp for the BENCH record: run_id + config fingerprint
+    # let the run-history store (tpunet/obs/history/) join this bench
+    # round to training runs of the same workload — previously they
+    # correlated only by BENCH_r* filename convention.
+    identity = {k: v for k, v in trainer.obs.registry.identity().items()
+                if k in ("run_id", "config_fingerprint")}
 
     # Pre-staged device batches (cycled), fresh rng per step.
     batches = []
@@ -248,7 +254,8 @@ def _measure(per_chip_batch: int, timed: int = 24, image_size: int = 224,
 
     trainer.close()
     return (timed * batch / best_dt / n_chips, flops, best_dt / timed,
-            traffic, xla_bytes, batch // n_chips, bytes_breakdown)
+            traffic, xla_bytes, batch // n_chips, bytes_breakdown,
+            identity)
 
 
 def main() -> None:
@@ -271,8 +278,8 @@ def main() -> None:
         # Harness sanity check on small shapes (CPU-friendly); numbers
         # are meaningless, the JSON plumbing is what's exercised.
         (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
-         breakdown) = _measure(8, timed=3, image_size=32,
-                               model_overrides=overrides)
+         breakdown, identity) = _measure(8, timed=3, image_size=32,
+                                         model_overrides=overrides)
         ref_ips = _measure(4, timed=3, image_size=32,
                            model_overrides=overrides)[0]
     elif "--peak-only" in sys.argv[1:]:
@@ -282,13 +289,13 @@ def main() -> None:
         # batch-512 figure would fabricate a measurement under a name
         # that promises the reference shape.
         (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
-         breakdown) = _measure(512, model_overrides=overrides)
+         breakdown, identity) = _measure(512, model_overrides=overrides)
         ref_ips = None
     else:
         # Peak-throughput shape (per-chip batch sweep optimum) and the
         # reference's exact shape (cifar10_128batch.py:59: batch 128).
         (peak_ips, flops, dt_step, traffic, xla_bytes, pcb,
-         breakdown) = _measure(512, model_overrides=overrides)
+         breakdown, identity) = _measure(512, model_overrides=overrides)
         ref_ips = _measure(128, model_overrides=overrides)[0]
 
     peak = _peak_flops_per_chip()
@@ -338,6 +345,9 @@ def main() -> None:
         # tracks xla_bytes_accessed_per_image to <1%).
         "bytes_per_image_breakdown": breakdown,
         "device_kind": jax.devices()[0].device_kind,
+        # History-store join keys (tpunet/obs/history/): the peak-shape
+        # trainer's run identity + config fingerprint.
+        **identity,
     }
     if overrides:
         # Variant runs are self-describing: a sweep artifact records

@@ -1,4 +1,4 @@
-"""Shared CLI plumbing for the budget-gate scripts.
+"""Shared CLI plumbing for the gate scripts.
 
 check_bytes_budget.py and check_serve_budget.py present the same
 command line (flag-anywhere ``--budget PATH`` plus one record path or
@@ -6,15 +6,54 @@ command line (flag-anywhere ``--budget PATH`` plus one record path or
 file, a piped bench stdout stream whose ``#``-note or warning lines
 precede the record — single-line or pretty-printed — or a driver-style
 artifact wrapping the record under ``"parsed"``). They also share the
-budget-entry lookup (``find_budget``). One module so a fix to either
-gate's plumbing cannot silently miss the other.
+budget-entry lookup (``find_budget``). ``scripts/obs_compare.py``
+shares the argv posture through ``split_flags``: unrecognized flags
+and wrong positional counts are LOUD exit-2 usage errors — silently
+gating the wrong file is a false pass in CI. One module so a fix to
+any gate's plumbing cannot silently miss the others.
 """
 
 from __future__ import annotations
 
 import json
 import sys
-from typing import Dict, Optional, Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+
+def split_flags(argv: Sequence[str], value_flags: Sequence[str] = (),
+                bool_flags: Sequence[str] = (),
+                ) -> Union[int, Tuple[Dict[str, object], List[str]]]:
+    """Flag-anywhere argv split shared by the gate CLIs.
+
+    Returns ``(flags, positionals)`` where ``flags`` maps recognized
+    flag names (without the ``--``) to their value (str) or True
+    (bool flags); or an ``int`` exit code on a usage error (message
+    already on stderr) — unknown flags are loud, same posture as
+    ``load_record_argv``.
+    """
+    flags: Dict[str, object] = {}
+    rest: List[str] = []
+    args = list(argv)
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a in value_flags:
+            if i + 1 >= len(args):
+                print(f"{a} needs a value", file=sys.stderr)
+                return 2
+            flags[a.lstrip("-")] = args[i + 1]
+            i += 2
+            continue
+        if a in bool_flags:
+            flags[a.lstrip("-")] = True
+            i += 1
+            continue
+        if a != "-" and a.startswith("-"):
+            print(f"unrecognized arguments: {a}", file=sys.stderr)
+            return 2
+        rest.append(a)
+        i += 1
+    return flags, rest
 
 
 def find_budget(budgets: Optional[Dict], device_kind: Optional[str]

@@ -220,6 +220,52 @@ def collect_serve_records() -> list:
     return sink.records
 
 
+def collect_regression_records() -> list:
+    """obs_regression via the real path: two synthetic record streams
+    summarized by the history store and compared (quantile rows with
+    DKW bounds, scalar rows with tolerance, alert/crash carryover)."""
+    from tpunet.obs.history import (compare_summaries, emit_regression,
+                                    summarize_run)
+    from tpunet.obs.registry import MemorySink, Registry
+
+    def stream(run_id, base, thr):
+        records = []
+        for ep in range(1, 4):
+            records.append({
+                "kind": "obs_epoch", "run_id": run_id,
+                "config_fingerprint": "fp0", "host": "h", "epoch": ep,
+                "step": 10 * ep, "steps": 10,
+                "step_time_mean_s": base, "step_time_p50_s": base,
+                "step_time_sample": [base + 0.0001 * i
+                                     for i in range(16)],
+                "tokens_per_sec": thr, "mfu": 0.4,
+                "live_processes": 1,
+            })
+        records.append({
+            "kind": "obs_serve", "run_id": run_id,
+            "config_fingerprint": "fp0", "uptime_s": 9.0,
+            "window_s": 3.0, "queue_depth": 0, "active_slots": 1,
+            "slots": 4, "requests_total": 8, "ttft_count": 8,
+            "ttft_sample": [base + 0.001 * i for i in range(8)],
+            "e2e_count": 8,
+            "e2e_sample": [base * 10 + 0.01 * i for i in range(8)],
+        })
+        records.append({"kind": "obs_alert", "run_id": run_id,
+                        "reason": "step_stall", "step": 5,
+                        "severity": "warn"})
+        return records
+
+    a = summarize_run(stream("run-a", 0.010, 100.0))
+    b = summarize_run(stream("run-b", 0.030, 60.0))
+    comparison = compare_summaries(a, b)
+    reg = Registry()
+    reg.set_identity(run_id="compare-check", process_index=0, host="h")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    emit_regression(reg, comparison)
+    return sink.records
+
+
 def collect_agg_records() -> list:
     """obs_fleet + every fleet obs_alert reason via a two-stream
     aggregator (one straggling, one leaking, both serving)."""
@@ -305,6 +351,7 @@ def main() -> int:
         records += collect_crash_records(tmp)
     records += collect_serve_records()
     records += collect_agg_records()
+    records += collect_regression_records()
     emitted_kinds = sorted({r.get("kind", PLAIN) for r in records})
     bad = undocumented(records, kinds, fields, global_fields)
     if bad:

@@ -34,10 +34,10 @@ EPOCHS_MEASURED = 5
 
 
 def build_trainer(obs_enabled: bool, workdir: str,
-                  flightrec: bool = True):
-    from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
-                               ModelConfig, ObsConfig, OptimConfig,
-                               TrainConfig)
+                  flightrec: bool = True, webhook: str = ""):
+    from tpunet.config import (CheckpointConfig, DataConfig,
+                               ExportConfig, MeshConfig, ModelConfig,
+                               ObsConfig, OptimConfig, TrainConfig)
     from tpunet.train.loop import Trainer
 
     cfg = TrainConfig(
@@ -52,7 +52,8 @@ def build_trainer(obs_enabled: bool, workdir: str,
         mesh=MeshConfig(),
         checkpoint=CheckpointConfig(directory=workdir, save_best=False,
                                     save_last=False),
-        obs=ObsConfig(enabled=obs_enabled, flightrec=flightrec),
+        obs=ObsConfig(enabled=obs_enabled, flightrec=flightrec,
+                      export=ExportConfig(webhook=webhook)),
     )
     return Trainer(cfg)
 
@@ -69,12 +70,19 @@ def time_epochs(trainer) -> list:
 
 
 def main() -> int:
+    # Fourth variant: the alert webhook configured at a dead endpoint
+    # but IDLE (a healthy tiny run fires no alerts) — its default-path
+    # cost is one kind-filter per emitted record, which must stay
+    # inside the same bar as everything else.
     results = {}
-    for label, enabled, rec in (("disabled", False, False),
-                                ("no-flightrec", True, False),
-                                ("default", True, True)):
+    for label, enabled, rec, hook in (
+            ("disabled", False, False, ""),
+            ("no-flightrec", True, False, ""),
+            ("default", True, True, ""),
+            ("webhook-idle", True, True, "http://127.0.0.1:9/hook")):
         with tempfile.TemporaryDirectory() as d:
-            trainer = build_trainer(enabled, d, flightrec=rec)
+            trainer = build_trainer(enabled, d, flightrec=rec,
+                                    webhook=hook)
             try:
                 results[label] = time_epochs(trainer)
             finally:
@@ -82,13 +90,17 @@ def main() -> int:
     off = statistics.median(results["disabled"])
     bare = statistics.median(results["no-flightrec"])
     on = statistics.median(results["default"])
+    hooked = statistics.median(results["webhook-idle"])
     ratio = on / off if off > 0 else float("inf")
     rec_ratio = on / bare if bare > 0 else float("inf")
+    hook_ratio = hooked / off if off > 0 else float("inf")
     print(f"epoch median: obs-disabled {off * 1e3:.1f}ms, "
           f"obs-no-flightrec {bare * 1e3:.1f}ms, "
-          f"obs-default {on * 1e3:.1f}ms")
+          f"obs-default {on * 1e3:.1f}ms, "
+          f"obs-webhook-idle {hooked * 1e3:.1f}ms")
     print(f"obs-vs-disabled ratio {ratio:.3f}, flightrec-on-vs-off "
-          f"ratio {rec_ratio:.3f} ({100 * (rec_ratio - 1):+.2f}%) "
+          f"ratio {rec_ratio:.3f} ({100 * (rec_ratio - 1):+.2f}%), "
+          f"webhook-idle-vs-disabled ratio {hook_ratio:.3f} "
           f"(threshold {MAX_RATIO})")
     fail = False
     if ratio > MAX_RATIO:
@@ -97,6 +109,10 @@ def main() -> int:
         fail = True
     if rec_ratio > MAX_RATIO:
         print("FAIL: the flight recorder alone exceeds the overhead "
+              "budget", file=sys.stderr)
+        fail = True
+    if hook_ratio > MAX_RATIO:
+        print("FAIL: an idle webhook sink exceeds the overhead "
               "budget", file=sys.stderr)
         fail = True
     if fail:

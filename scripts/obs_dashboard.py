@@ -390,8 +390,18 @@ def _fleet_alerts(agg):
     return alerts
 
 
+def _regression_rows(agg):
+    """Fleet regression panel rows: pairwise last-window compare of
+    trainer streams sharing a config fingerprint
+    (tpunet/obs/history/compare.stream_regressions) — the cross-run
+    view that makes an elastic rerun judgeable against its static
+    baseline from the same dashboard."""
+    from tpunet.obs.history import stream_regressions
+    return stream_regressions(agg.streams())
+
+
 def render_fleet_terminal(rollup: dict, ages: dict, source: str,
-                          alerts=()) -> str:
+                          alerts=(), regressions=()) -> str:
     """One text frame of the fleet rollup + per-stream table."""
     out = [f"tpunet fleet dashboard — {source} — "
            f"{time.strftime('%H:%M:%S')}"]
@@ -444,6 +454,19 @@ def render_fleet_terminal(rollup: dict, ages: dict, source: str,
                 f"{'-' if age is None else f'{age:6.1f}'}")
         out.append("")
 
+    if regressions:
+        flagged = [r for r in regressions
+                   if r["verdict"] != "within_error"]
+        out.append(f"REGRESSION COMPARE ({len(regressions)} pair(s), "
+                   f"{len(flagged)} outside error bars):")
+        for r in regressions[-6:]:
+            out.append(
+                f"  [{r['verdict']:>12}] {r['stream']:<24.24} vs "
+                f"{r['base']:<24.24} p50 {_ms(r['a'])} -> "
+                f"{_ms(r['b'])}ms "
+                f"({100 * (r.get('delta_frac') or 0):+.1f}%)")
+        out.append("")
+
     if rollup.get("serve_replicas"):
         out.append(
             f"serve: {rollup['serve_replicas']} replicas  "
@@ -463,9 +486,10 @@ def render_fleet_terminal(rollup: dict, ages: dict, source: str,
 
 
 def render_fleet_html(rollup: dict, streams, source: str,
-                      alerts=()) -> str:
+                      alerts=(), regressions=()) -> str:
     """Static fleet report: rollup tiles, per-stream step-time chart,
-    per-stream table, serve SLO panel, fleet alert table."""
+    regression-compare panel, per-stream table, serve SLO panel,
+    fleet alert table."""
     e = html_mod.escape
     tiles = []
 
@@ -509,6 +533,25 @@ def render_fleet_html(rollup: dict, streams, source: str,
                      'per stream</h2><div class="legend">'
                      + "&nbsp;&nbsp;".join(legend) + "</div>"
                      + chart + "</div>")
+
+    if regressions:
+        body = []
+        for r in regressions:
+            frac = r.get("delta_frac")
+            body.append(
+                f"<tr><td>{e(str(r['stream']))}</td>"
+                f"<td>{e(str(r['base']))}</td>"
+                f"<td>{e(str(r.get('fingerprint', '')))}</td>"
+                f"<td>{_ms(r['a'])}</td><td>{_ms(r['b'])}</td>"
+                f"<td>{'-' if frac is None else f'{100 * frac:+.1f}%'}"
+                f"</td><td>{e(r['verdict'])}</td></tr>")
+        cards.append(
+            '<div class="card"><h2>Regression compare (same config '
+            "fingerprint, step-time p50 vs DKW error bars)</h2>"
+            "<table><tr><th>stream</th><th>baseline</th>"
+            "<th>fingerprint</th><th>base p50 ms</th><th>p50 ms</th>"
+            "<th>delta</th><th>verdict</th></tr>"
+            + "".join(body) + "</table></div>")
 
     rows = rollup.get("per_stream", [])
     if rows:
@@ -685,7 +728,8 @@ def serve_http(port: int, buf: RecordBuffer, source_name: str,
             if agg is not None:
                 text = render_fleet_terminal(
                     agg.rollup(), agg.heartbeat_ages(), source_name,
-                    alerts=_fleet_alerts(agg))
+                    alerts=_fleet_alerts(agg),
+                    regressions=_regression_rows(agg))
             else:
                 text = render_terminal(summarize(buf.snapshot()),
                                        source_name)
@@ -748,6 +792,11 @@ def main(argv=None) -> int:
                     help="GaugePredicate rule evaluated fleet-wide AND "
                          "per stream (e.g. 'serve_queue_depth > 10'); "
                          "repeatable")
+    ap.add_argument("--webhook", metavar="URL",
+                    help="fleet mode: POST one templated JSON payload "
+                         "per fired alert (straggler/crash/stale/"
+                         "mem_growth/--rule) to this URL — wire "
+                         "format in docs/metrics_schema.md")
     args = ap.parse_args(argv)
 
     if bool(args.path) == (args.listen is not None):
@@ -764,12 +813,24 @@ def main(argv=None) -> int:
     fleet = args.fleet or len(paths) > 1
 
     agg = None
+    webhook = None
     if fleet:
         from tpunet.obs.agg import Aggregator
         agg = Aggregator(straggler_factor=args.straggler_factor,
                          stream_stale_s=args.stale_after,
                          mem_growth_bytes_per_epoch=args.mem_growth,
                          rules=tuple(args.rule))
+        if args.webhook:
+            # The bridge emits its obs_alert records through the
+            # aggregator's registry; attaching the webhook sink there
+            # turns every fired fleet alert into one POST.
+            from tpunet.obs.export import AlertWebhook
+            webhook = AlertWebhook(args.webhook, registry=agg.registry)
+            agg.registry.add_sink(webhook)
+    elif args.webhook:
+        ap.error("--webhook needs fleet mode (several paths or "
+                 "--fleet): only the fleet aggregator emits alerts "
+                 "from the dashboard process")
 
     buf = RecordBuffer()
     offsets = {p: 0 for p in paths}
@@ -818,14 +879,28 @@ def main(argv=None) -> int:
         if agg is not None:
             return render_fleet_terminal(view, agg.heartbeat_ages(),
                                          source,
-                                         alerts=_fleet_alerts(agg))
+                                         alerts=_fleet_alerts(agg),
+                                         regressions=_regression_rows(agg))
         return render_terminal(view, source, last=args.last)
 
     def render_page(view):
         if agg is not None:
             return render_fleet_html(view, agg.streams(), source,
-                                     alerts=_fleet_alerts(agg))
+                                     alerts=_fleet_alerts(agg),
+                                     regressions=_regression_rows(agg))
         return render_html(view, source)
+
+    def close_webhook() -> None:
+        # Flush queued/backing-off pages before exit: without the
+        # close, a page mid-retry dies with the daemon thread —
+        # neither delivered, dead-lettered, NOR counted dropped.
+        if webhook is None:
+            return
+        webhook.close()
+        st = webhook.stats()
+        if st["send_errors"] or st["dropped"]:
+            print(f"webhook delivery incomplete: {st}",
+                  file=sys.stderr)
 
     view = refresh()
     if args.html:
@@ -833,6 +908,7 @@ def main(argv=None) -> int:
             f.write(render_page(view))
     if args.once:
         print(render_text(view))
+        close_webhook()
         return 0
 
     try:
@@ -850,6 +926,8 @@ def main(argv=None) -> int:
             view = refresh()
     except KeyboardInterrupt:
         return 0
+    finally:
+        close_webhook()
 
 
 if __name__ == "__main__":

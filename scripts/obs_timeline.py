@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Unified Perfetto timeline from one or more runs' flight recorders.
+
+    # one training run -> trace.json (load at ui.perfetto.dev)
+    python scripts/obs_timeline.py checkpoints/ -o trace.json
+
+    # trainer + serve replica on one clock
+    python scripts/obs_timeline.py train-run/ serve-run/ -o trace.json
+
+Converts the crash-durable flightrec event rings (recorded by default
+in every run: span begin/end pairs, host-thread busy/idle flips,
+serve request lifecycles, alerts, epoch marks) into chrome-trace JSON
+— host threads, device phases, and requests on one wall clock. Wire
+details in docs/metrics_schema.md "Timeline export".
+
+Exit: 0 written, 1 no rings found, 2 usage.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _gate_cli import split_flags  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parsed = split_flags(sys.argv[1:] if argv is None else argv,
+                         ("-o", "--out"))
+    if isinstance(parsed, int):
+        return parsed
+    flags, paths = parsed
+    if not paths:
+        print("usage: obs_timeline.py RUN_DIR... [-o trace.json]",
+              file=sys.stderr)
+        return 2
+    out = str(flags.get("o") or flags.get("out") or "trace.json")
+
+    from tpunet.obs.history import write_trace
+    try:
+        trace = write_trace(paths, out)
+    except FileNotFoundError as e:
+        print(f"obs_timeline: {e}", file=sys.stderr)
+        return 1
+    events = trace["traceEvents"]
+    kinds = {"B": 0, "X": 0, "i": 0, "M": 0}
+    for e in events:
+        kinds[e["ph"]] = kinds.get(e["ph"], 0) + 1
+    span_ms = 0.0
+    ts = [e["ts"] for e in events if e["ph"] != "M"]
+    if ts:
+        span_ms = (max(ts) - min(ts)) / 1e3
+    print(f"obs_timeline: wrote {out}: {len(events)} events "
+          f"({kinds.get('B', 0)} span pairs, {kinds.get('X', 0)} "
+          f"complete, {kinds.get('i', 0)} instants) spanning "
+          f"{span_ms:.1f} ms — open at ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+# tpucheck R6 bad fixture: a dynamically-named family with NO
+# documented placeholder shape — a bare `<name>`-only doc span must
+# not act as a match-everything wildcard either.
+
+
+def account(registry, name):
+    registry.counter(f"pool_{name}_dropped").inc()

@@ -1,0 +1,9 @@
+# tpucheck R6 bad fixture: the drift class — an instrument created in
+# code that the schema doc never heard of. check_metrics_schema only
+# catches this at runtime IF some driven path emits a record carrying
+# it; the static rule catches the name at creation.
+
+
+def account(registry):
+    registry.counter("widgets_total").inc()         # documented: fine
+    registry.gauge("surprise_depth").set(3)         # undocumented

@@ -1,0 +1,8 @@
+# tpucheck R6 good fixture: every literal instrument name appears in
+# docs/metrics_schema.md.
+
+
+def account(registry):
+    registry.counter("widgets_total").inc()
+    registry.gauge("widget_depth").set(3)
+    registry.histogram("widget_latency_s").observe(0.01)

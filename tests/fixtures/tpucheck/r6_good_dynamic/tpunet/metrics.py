@@ -1,0 +1,7 @@
+# tpucheck R6 good fixture: a dynamically-named instrument family
+# whose shape is documented with a <hole> placeholder.
+
+
+def account(registry, name):
+    registry.counter(f"pool_{name}_dropped").inc()
+    registry.gauge(f"pool_{name}_depth").set(1)

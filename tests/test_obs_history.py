@@ -1,0 +1,472 @@
+"""Run-history store, cross-run regression compare, and the unified
+timeline exporter (tpunet/obs/history/).
+
+The properties under test: config fingerprints are stable across
+bookkeeping changes and sensitive to compute changes; summaries and
+compare verdicts are deterministic functions of the run records (the
+checked-in fixture run dirs pin byte-identical CLI output and exit
+codes across invocations); a regression verdict requires the two
+runs' quantile confidence intervals to be DISJOINT under the DKW
+rank-error bounds; and the timeline exporter emits schema-valid
+chrome-trace JSON (phase-paired B/E, non-negative X durations,
+monotonic timestamps) from both synthetic rings and a real 2-step CPU
+run + serve engine.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "history")
+RUN_A = os.path.join(FIXTURES, "runA")
+RUN_B = os.path.join(FIXTURES, "runB")
+
+from tpunet.obs.history import (RunHistory, bench_entry,  # noqa: E402
+                                build_timeline, compare_summaries,
+                                config_fingerprint, quantile_verdict,
+                                stream_regressions, summarize_run,
+                                train_fingerprint)
+from tpunet.utils.logging import MetricsLogger  # noqa: E402
+
+
+def _records(run_dir):
+    return MetricsLogger.read_records(
+        os.path.join(run_dir, "metrics.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_stable_and_selective():
+    import dataclasses
+
+    from tpunet.config import (CheckpointConfig, ModelConfig,
+                               TrainConfig)
+    cfg = TrainConfig()
+    fp = train_fingerprint(cfg)
+    assert fp == train_fingerprint(TrainConfig())
+    # Bookkeeping changes must NOT move the fingerprint...
+    moved = dataclasses.replace(cfg, checkpoint=CheckpointConfig(
+        directory="/somewhere/else"))
+    assert train_fingerprint(moved) == fp
+    # ...compute changes must.
+    wider = dataclasses.replace(cfg, model=ModelConfig(width_mult=0.5))
+    assert train_fingerprint(wider) != fp
+    assert len(fp) == 12
+
+
+def test_fingerprint_canonicalizes_dicts():
+    assert config_fingerprint({"a": 1, "b": 2}) \
+        == config_fingerprint({"b": 2, "a": 1})
+    assert config_fingerprint({"a": 1}) != config_fingerprint({"a": 2})
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_run_fixture_fields():
+    s = summarize_run(_records(RUN_A), source=RUN_A)
+    assert s["run_id"] == "runA"
+    assert s["config_fingerprint"] == "fixfp0001ab"
+    assert s["epochs"] == 3 and s["steps_total"] == 30
+    assert s["step_lo"] == 1 and s["step_hi"] == 30
+    assert s["throughput"] == 1000.0
+    assert s["throughput_unit"] == "examples"
+    # Merged p50 sits inside the fixture sample range, with a bound.
+    assert 0.010 <= s["step_time_p50_s"] <= 0.0165
+    assert s["step_time_rank_err"] > 0
+
+
+def test_summarize_is_deterministic():
+    a1 = summarize_run(_records(RUN_A), source=RUN_A)
+    a2 = summarize_run(_records(RUN_A), source=RUN_A)
+    assert json.dumps(a1, sort_keys=True) \
+        == json.dumps(a2, sort_keys=True)
+
+
+def test_history_roundtrip_and_latest_wins(tmp_path):
+    h = RunHistory(str(tmp_path / "hist"))
+    assert h.runs() == []
+    h.ingest_run(RUN_A)
+    h.ingest_run(RUN_B)
+    h.ingest_run(RUN_A)          # re-ingest: supersedes, not duplicates
+    runs = h.runs()
+    assert sorted(r["run_id"] for r in runs) == ["runA", "runB"]
+    assert len(h.entries("run")) == 3          # append-only on disk
+    assert h.run("runB")["run_id"] == "runB"
+    # fingerprint-scoped view
+    assert len(h.runs(fingerprint="fixfp0001ab")) == 2
+    assert h.runs(fingerprint="nope") == []
+
+
+def test_history_rejects_non_run_dir(tmp_path):
+    h = RunHistory(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        h.ingest_run(str(tmp_path / "empty"))
+
+
+def test_bench_join_by_run_id_and_fingerprint(tmp_path):
+    h = RunHistory(str(tmp_path))
+    h.ingest_run(RUN_A)
+    bench = {"parsed": {"metric": "train_images_per_sec_per_chip",
+                        "value": 5016.0, "unit": "img/s/chip",
+                        "run_id": "runA",
+                        "config_fingerprint": "fixfp0001ab",
+                        "device_kind": "TPU v5 lite"}}
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(json.dumps(bench))
+    entry = h.ingest_bench(str(p))
+    assert entry["value"] == 5016.0
+    joined = h.bench_for(h.run("runA"))
+    assert len(joined) == 1 and joined[0]["run_id"] == "runA"
+    # A bench row with only the fingerprint still joins.
+    bench["parsed"].pop("run_id")
+    p2 = tmp_path / "BENCH_r100.json"
+    p2.write_text(json.dumps(bench))
+    h.ingest_bench(str(p2))
+    assert len(h.bench_for(h.run("runA"))) == 2
+
+
+# ---------------------------------------------------------------------------
+# compare
+# ---------------------------------------------------------------------------
+
+
+def test_compare_identical_runs_is_ok():
+    a = summarize_run(_records(RUN_A), source=RUN_A)
+    cmp = compare_summaries(a, a)
+    assert cmp["verdict"] == "ok"
+    assert cmp["regressions"] == 0
+    assert all(m["verdict"] == "within_error" for m in cmp["metrics"])
+
+
+def test_compare_flags_clear_regression_with_bounds():
+    a = summarize_run(_records(RUN_A), source=RUN_A)
+    b = summarize_run(_records(RUN_B), source=RUN_B)
+    cmp = compare_summaries(a, b)
+    assert cmp["fingerprint_match"] is True
+    assert cmp["step_lo"] == 1 and cmp["step_hi"] == 30
+    assert cmp["verdict"] == "regression"
+    p50 = next(m for m in cmp["metrics"]
+               if m["metric"] == "step_time_p50_s")
+    # The verdict's definition: disjoint confidence intervals.
+    assert p50["b_lo"] > p50["a_hi"]
+    assert p50["verdict"] == "regression"
+    thr = next(m for m in cmp["metrics"]
+               if m["metric"] == "throughput_mean")
+    assert thr["verdict"] == "regression" and thr["delta_frac"] < 0
+
+
+def test_small_shift_stays_within_error_bars():
+    """A delta smaller than the combined rank-error bars must NOT be
+    called a regression — the bound is the point of the design."""
+    base = [0.010 + 0.001 * i for i in range(8)]      # coarse sample
+    shifted = [v + 0.0004 for v in base]              # < one rank step
+    row = quantile_verdict([(base, 100, False)],
+                           [(shifted, 100, False)], 50)
+    assert row["verdict"] == "within_error"
+    # Same shift against a dense, exact sample IS a verdict.
+    dense = [0.010 + 0.00001 * i for i in range(256)]
+    dshift = [v + 0.0004 for v in dense]
+    row2 = quantile_verdict([(dense, 10000, False)],
+                            [(dshift, 10000, False)], 50)
+    assert row2["verdict"] == "regression"
+
+
+def test_saturated_windows_widen_the_bars():
+    sample = [0.010 + 0.0001 * i for i in range(64)]
+    exact = quantile_verdict([(sample, 64, False)],
+                             [(sample, 64, False)], 50)
+    saturated = quantile_verdict([(sample, 10 ** 6, True)],
+                                 [(sample, 10 ** 6, True)], 50)
+    assert saturated["rank_err_a"] > exact["rank_err_a"]
+
+
+def test_compare_fingerprint_mismatch_is_reported():
+    a = summarize_run(_records(RUN_A), source=RUN_A)
+    b = dict(summarize_run(_records(RUN_B), source=RUN_B))
+    b["config_fingerprint"] = "otherfp00000"
+    cmp = compare_summaries(a, b)
+    assert cmp["fingerprint_match"] is False
+
+
+def test_compare_no_data_is_incomparable():
+    cmp = compare_summaries({"run_id": "x"}, {"run_id": "y"})
+    assert cmp["verdict"] == "incomparable"
+
+
+# ---------------------------------------------------------------------------
+# CLI: deterministic, exit-coded like the budget gates
+# ---------------------------------------------------------------------------
+
+
+def _cli(argv, capsys):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import obs_compare
+    finally:
+        sys.path.pop(0)
+    rc = obs_compare.main(argv)
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+def test_cli_verdict_is_deterministic_and_exit_coded(capsys):
+    rc1, out1, _ = _cli([RUN_A, RUN_B], capsys)
+    rc2, out2, _ = _cli([RUN_A, RUN_B], capsys)
+    assert rc1 == rc2 == 3                  # regression, like exit 3
+    assert out1 == out2                     # byte-identical verdict
+    assert "REGRESSION" in out1
+    rc_ok, out_ok, _ = _cli([RUN_A, RUN_A], capsys)
+    assert rc_ok == 0 and "OK" in out_ok
+
+
+def test_cli_usage_errors_are_loud(capsys):
+    rc, _, err = _cli([RUN_A], capsys)
+    assert rc == 2 and "usage" in err
+    rc, _, err = _cli([RUN_A, RUN_B, "--bogus"], capsys)
+    assert rc == 2 and "bogus" in err
+    rc, _, err = _cli([RUN_A, str(RUN_B) + "-missing"], capsys)
+    assert rc == 2
+
+
+def test_cli_fingerprint_mismatch_refused_then_allowed(
+        tmp_path, capsys):
+    # Same records, different stamped fingerprint.
+    alt = tmp_path / "runC"
+    alt.mkdir()
+    with open(alt / "metrics.jsonl", "w") as f:
+        for r in _records(RUN_B):
+            r = dict(r, config_fingerprint="otherfp00000")
+            f.write(json.dumps(r) + "\n")
+    rc, _, err = _cli([RUN_A, str(alt)], capsys)
+    assert rc == 2 and "fingerprints differ" in err
+    rc, out, _ = _cli([RUN_A, str(alt),
+                       "--allow-fingerprint-mismatch"], capsys)
+    assert rc == 3 and "REGRESSION" in out
+
+
+def test_cli_json_and_emit(tmp_path, capsys):
+    out_path = tmp_path / "metrics.jsonl"
+    rc, out, _ = _cli([RUN_A, RUN_B, "--json", "--emit",
+                       str(out_path)], capsys)
+    assert rc == 3
+    parsed = json.loads(out)
+    assert parsed["verdict"] == "regression"
+    emitted = MetricsLogger.read_records(str(out_path))
+    assert len(emitted) == 1
+    assert emitted[0]["kind"] == "obs_regression"
+
+
+# ---------------------------------------------------------------------------
+# fleet dashboard panel rows
+# ---------------------------------------------------------------------------
+
+
+def test_stream_regressions_from_aggregator():
+    from tpunet.obs.agg import Aggregator
+    agg = Aggregator()
+    for rec in _records(RUN_A) + _records(RUN_B):
+        agg.ingest(rec, stamp_time=False)
+    rows = stream_regressions(agg.streams())
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["fingerprint"] == "fixfp0001ab"
+    assert row["base"] == "runA/0" and row["stream"] == "runB/0"
+    assert row["verdict"] == "regression"
+    # ...and the fleet dashboard renders the panel from these rows.
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import obs_dashboard
+    finally:
+        sys.path.pop(0)
+    text = obs_dashboard.render_fleet_terminal(
+        agg.rollup(), {}, "test", regressions=rows)
+    assert "REGRESSION COMPARE" in text
+    html = obs_dashboard.render_fleet_html(
+        agg.rollup(), agg.streams(), "test", regressions=rows)
+    assert "Regression compare" in html and "fixfp0001ab" in html
+
+
+# ---------------------------------------------------------------------------
+# timeline exporter
+# ---------------------------------------------------------------------------
+
+
+def _validate_chrome_trace(trace):
+    """The chrome-trace invariants the acceptance bar names: known
+    phases only, monotonic timestamps, stack-paired B/E per
+    (pid, tid), non-negative X durations."""
+    events = trace["traceEvents"]
+    assert events
+    stacks = {}
+    last_ts = None
+    for e in events:
+        assert e["ph"] in ("B", "E", "X", "i", "M"), e
+        assert "pid" in e and "tid" in e and "ts" in e
+        if e["ph"] == "M":
+            continue
+        if last_ts is not None:
+            assert e["ts"] >= last_ts, "timestamps must not go back"
+        last_ts = e["ts"]
+        key = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        elif e["ph"] == "E":
+            assert stacks.get(key), f"E without open B on {key}"
+            stacks[key].pop()
+        elif e["ph"] == "X":
+            assert e["dur"] >= 0
+    leftovers = {k: v for k, v in stacks.items() if v}
+    assert not leftovers, f"unclosed B events: {leftovers}"
+
+
+def test_timeline_synthetic_ring(tmp_path):
+    from tpunet.obs.flightrec.ring import EventRing
+    d = tmp_path / "run" / "flightrec"
+    d.mkdir(parents=True)
+    ring = EventRing(str(d / "events.ring"), 64)
+    ring.record("span", "step 1")
+    ring.record("span", "tpunet/data_wait")
+    ring.record("span_end", "tpunet/data_wait")
+    ring.record("span_end", "step 1")
+    ring.record("thread", "busy ckpt-writer")
+    ring.record("thread", "idle ckpt-writer")
+    ring.record("req", "submit 7 len=5")
+    ring.record("req", "prefill 7")
+    ring.record("req", "first_token 7")
+    ring.record("req", "finish 7 length")
+    ring.record("alert", "step_stall step=9")
+    ring.record("span", "never closed")
+    ring.close()
+    trace = build_timeline([str(tmp_path / "run")])
+    _validate_chrome_trace(trace)
+    events = trace["traceEvents"]
+    names = [e["name"] for e in events]
+    assert names.count("step 1") == 2          # paired B/E
+    assert "never closed" in names             # force-closed at tail
+    busy = [e for e in events
+            if e["ph"] == "X" and e["name"] == "busy"]
+    assert len(busy) == 1
+    phases = {e["name"] for e in events
+              if e["ph"] == "X" and e.get("args", {}).get("req")}
+    assert phases == {"queue", "prefill", "decode"}
+    decode = next(e for e in events if e["ph"] == "X"
+                  and e["name"] == "decode")
+    assert decode["args"]["finish_reason"] == "length"
+    assert any(e["ph"] == "i" and "step_stall" in e["name"]
+               for e in events)
+
+
+def test_timeline_requires_a_ring(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        build_timeline([str(tmp_path)])
+
+
+def test_timeline_from_real_run_and_serve(tmp_path):
+    """The acceptance bar: a real 2-step CPU training run plus a real
+    serve engine produce one schema-valid trace containing at least
+    one span pair, one host-thread busy track, and one complete serve
+    request lifecycle."""
+    import jax
+
+    from tpunet.config import (CheckpointConfig, DataConfig,
+                               MeshConfig, ModelConfig, ObsConfig,
+                               OptimConfig, ServeConfig, TrainConfig)
+    from tpunet.train.loop import Trainer
+
+    train_dir = tmp_path / "train"
+    cfg = TrainConfig(
+        epochs=1,
+        data=DataConfig(dataset="synthetic_lm", batch_size=16,
+                        synthetic_train_size=32, synthetic_test_size=16,
+                        seq_len=64, vocab_size=32),
+        model=ModelConfig(name="lm", vit_hidden=64, vit_depth=2,
+                          vit_heads=4, dropout_rate=0.0,
+                          dtype="float32", vocab_size=32,
+                          max_seq_len=64),
+        optim=OptimConfig(learning_rate=3e-3),
+        mesh=MeshConfig(),
+        checkpoint=CheckpointConfig(directory=str(train_dir),
+                                    save_best=False, save_last=False),
+        obs=ObsConfig(step_records_every=1),
+    )
+    trainer = Trainer(cfg)
+    try:
+        trainer.train()                          # 2 steps (32/16)
+    finally:
+        trainer.close()
+
+    # A real serve engine in its own "replica" dir: the global
+    # recorder collects engine thread beats + request lifecycles.
+    from tpunet.models import create_model, init_variables
+    from tpunet.obs import flightrec
+    from tpunet.serve import Engine
+
+    serve_dir = tmp_path / "serve"
+    rec = flightrec.install(str(serve_dir), watcher=False,
+                            native=False)
+    try:
+        tiny = ModelConfig(name="lm", vit_hidden=32, vit_depth=2,
+                           vit_heads=2, dropout_rate=0.0,
+                           dtype="float32", vocab_size=31,
+                           max_seq_len=48)
+        model = create_model(tiny)
+        variables = init_variables(model, jax.random.PRNGKey(0),
+                                   seq_len=8)
+        eng = Engine(model, variables,
+                     ServeConfig(slots=2, queue_max=4,
+                                 prefill_buckets=(8,),
+                                 default_max_new_tokens=4,
+                                 emit_every_s=0.0)).start()
+        try:
+            reqs = [eng.submit(np.array([1, 2, 3], np.int32),
+                               max_new_tokens=3) for _ in range(2)]
+            for r in reqs:
+                r.result(timeout=120)
+        finally:
+            eng.stop()
+    finally:
+        flightrec.close(rec)
+
+    trace = build_timeline([str(train_dir), str(serve_dir)])
+    _validate_chrome_trace(trace)
+    events = trace["traceEvents"]
+    # >= 1 span pair (the training step spans record B/E into the ring)
+    assert any(e["ph"] == "B" for e in events)
+    # >= 1 host-thread busy track (serve engine busy/idle flips)
+    assert any(e["ph"] == "X" and e["name"] == "busy" for e in events)
+    # >= 1 complete serve request lifecycle
+    req_events = [e for e in events
+                  if e["ph"] == "X" and e.get("args", {}).get("req")]
+    assert {"queue", "decode"} <= {e["name"] for e in req_events}
+    finished = [e for e in req_events
+                if e.get("args", {}).get("finish_reason")]
+    assert finished, "no request reached a finish reason"
+    # Two trace processes, labeled.
+    pids = {e["pid"] for e in events}
+    assert len(pids) == 2
+    names = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert any("train" in n for n in names)
+    assert any("serve" in n for n in names)
+    # ...and the CLI writes a loadable file.
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import obs_timeline
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "trace.json"
+    rc = obs_timeline.main([str(train_dir), str(serve_dir),
+                            "-o", str(out)])
+    assert rc == 0
+    with open(out) as f:
+        _validate_chrome_trace(json.load(f))

@@ -1,0 +1,297 @@
+"""Alert webhook sink (tpunet/obs/export/webhook.py): the paging
+contract.
+
+Promises under test, mirroring the exporter layer's discipline
+(tests/test_obs_export.py): ``write`` never blocks or raises whatever
+the endpoint state; non-alert kinds are filtered before any queue
+work; a full queue drops AND counts; a flaky endpoint is retried with
+backoff and eventually delivers (counted once, as sent); a dead
+endpoint exhausts retries into the bounded dead-letter list; close()
+flushes in order with a bounded timeout; and the accounting identity
+``enqueued == sent + send_errors + dropped`` survives every mode.
+Plus the fleet acceptance path: an injected straggler in a
+two-replica aggregator fires exactly one webhook POST with the
+documented payload.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpunet.obs.agg import Aggregator
+from tpunet.obs.export import (AlertWebhook, WebhookTransport,
+                               build_payload)
+from tpunet.obs.registry import Registry
+
+
+class FlakyTransport:
+    """In-memory endpoint: fails the first ``fail_first`` sends (the
+    5xx-then-recover shape), records delivered payloads in order."""
+
+    def __init__(self, fail_first: int = 0, gate: threading.Event = None):
+        self.payloads = []
+        self.fail_first = fail_first
+        self.gate = gate
+        self.attempts = 0
+
+    def send(self, payload: dict) -> None:
+        if self.gate is not None:
+            self.gate.wait()
+        self.attempts += 1
+        if self.attempts <= self.fail_first:
+            raise IOError("injected 5xx")
+        self.payloads.append(payload)
+
+
+def _receiver():
+    """Stdlib HTTP receiver: 200s everything, collects JSON bodies."""
+    got = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, got
+
+
+ALERT = {"kind": "obs_alert", "reason": "step_stall", "step": 7,
+         "severity": "fatal", "run_id": "r1", "host": "h"}
+
+
+# ---------------------------------------------------------------------------
+# payload template
+# ---------------------------------------------------------------------------
+
+
+def test_payload_matches_documented_wire_format():
+    p = build_payload(ALERT)
+    assert p["source"] == "tpunet"
+    assert p["kind"] == "obs_alert" and p["reason"] == "step_stall"
+    assert p["severity"] == "fatal"
+    assert p["run_id"] == "r1" and p["host"] == "h"
+    assert p["detail"] == ALERT
+    assert "step_stall" in p["summary"]
+    crash = build_payload({"kind": "obs_crash", "cause": "SIGSEGV",
+                           "report_path": "/tmp/r.json"})
+    assert crash["reason"] == "crash" and "SIGSEGV" in crash["summary"]
+    reg = build_payload({"kind": "obs_regression",
+                         "verdict": "regression", "regressions": 3,
+                         "run_a": "A", "run_b": "B"})
+    assert reg["reason"] == "regression" and "3" in reg["summary"]
+
+
+def test_non_alert_kinds_are_filtered_before_the_queue():
+    transport = FlakyTransport()
+    wh = AlertWebhook(transport, queue_size=2)
+    for i in range(100):
+        wh.write({"kind": "obs_step", "step": i})
+        wh.write({"kind": "obs_epoch", "epoch": i})
+    wh.close()
+    assert transport.payloads == []
+    assert wh.stats()["enqueued"] == 0
+    assert wh.stats()["dropped"] == 0        # filtered, not dropped
+
+
+# ---------------------------------------------------------------------------
+# failure modes
+# ---------------------------------------------------------------------------
+
+
+def test_dead_endpoint_never_blocks_and_dead_letters():
+    reg = Registry()
+    # Closed port: connection refused immediately.
+    wh = AlertWebhook(WebhookTransport("http://127.0.0.1:9/hook",
+                                       timeout=0.2),
+                      max_retries=1, backoff_s=0.01, registry=reg)
+    reg.add_sink(wh)
+    t0 = time.perf_counter()
+    reg.emit("obs_alert", dict(ALERT))
+    assert time.perf_counter() - t0 < 0.5    # write is put_nowait
+    wh.close()
+    stats = wh.stats()
+    assert stats["send_errors"] == 1 and stats["dead_letter"] == 1
+    assert stats["enqueued"] == stats["sent"] + stats["send_errors"] \
+        + stats["dropped"]
+    dead = wh.dead_letters()
+    assert len(dead) == 1
+    assert dead[0]["payload"]["reason"] == "step_stall"
+    assert dead[0]["attempts"] == 2          # first try + 1 retry
+    assert reg.counter("webhook_dead_letter").value == 1
+
+
+def test_flaky_endpoint_recovers_via_backoff():
+    """The 5xx-then-recover shape: two failures, then delivery — the
+    page arrives once, retries are counted, nothing dead-letters."""
+    transport = FlakyTransport(fail_first=2)
+    wh = AlertWebhook(transport, max_retries=3, backoff_s=0.01)
+    wh.write(dict(ALERT))
+    wh.close()
+    assert len(transport.payloads) == 1
+    stats = wh.stats()
+    assert stats["sent"] == 1 and stats["send_errors"] == 0
+    assert stats["retries"] == 2
+    assert stats["enqueued"] == stats["sent"] + stats["send_errors"] \
+        + stats["dropped"]
+
+
+def test_queue_overflow_drops_and_counts():
+    gate = threading.Event()                 # wedged endpoint
+    transport = FlakyTransport(gate=gate)
+    reg = Registry()
+    wh = AlertWebhook(transport, queue_size=2, flush_timeout=2.0,
+                      registry=reg)
+    t0 = time.perf_counter()
+    for i in range(20):
+        wh.write({**ALERT, "step": i})
+    assert time.perf_counter() - t0 < 0.5    # pure queue puts
+    # 2 queued (+possibly 1 at the gate); the rest dropped and counted.
+    assert reg.counter("webhook_dropped").value >= 17
+    gate.set()
+    wh.close()
+    stats = wh.stats()
+    # Total accounting: 20 writes == delivered + dropped; every page
+    # that entered the queue was delivered.
+    assert stats["sent"] == stats["enqueued"]
+    assert stats["send_errors"] == 0
+    assert stats["sent"] + stats["dropped"] == 20
+
+
+def test_flush_on_close_delivers_in_order():
+    transport = FlakyTransport()
+    wh = AlertWebhook(transport, queue_size=64)
+    for i in range(10):
+        wh.write({**ALERT, "step": i})
+    wh.close()
+    assert [p["detail"]["step"] for p in transport.payloads] \
+        == list(range(10))
+    # Writes after close are dropped and counted, never delivered.
+    wh.write(dict(ALERT))
+    assert wh.stats()["dropped"] == 1
+
+
+def test_wedged_transport_close_times_out_and_accounts():
+    gate = threading.Event()                 # never set: fully wedged
+    transport = FlakyTransport(gate=gate)
+    wh = AlertWebhook(transport, queue_size=8, flush_timeout=0.3)
+    for i in range(5):
+        wh.write({**ALERT, "step": i})
+    t0 = time.perf_counter()
+    wh.close()
+    assert time.perf_counter() - t0 < 3.0    # bounded, not forever
+    stats = wh.stats()
+    assert stats["enqueued"] == stats["sent"] + stats["send_errors"] \
+        + stats["dropped"]
+    assert stats["dropped"] >= 4
+    gate.set()                               # unwedge the daemon
+
+
+def test_drain_thread_registers_in_thread_registry():
+    from tpunet.obs.flightrec.threads import THREADS
+    wh = AlertWebhook(FlakyTransport(), queue_size=2)
+    try:
+        names = [h.name for h in THREADS.handles()]
+        assert "webhook" in names
+    finally:
+        wh.close()
+
+
+def test_transport_url_validation():
+    with pytest.raises(ValueError):
+        WebhookTransport("not-a-url")
+    with pytest.raises(ValueError):
+        AlertWebhook("udp://x")
+
+
+def test_build_exporters_wires_the_webhook():
+    from tpunet.config import ExportConfig
+    from tpunet.obs.export import build_exporters
+    reg = Registry()
+    out = build_exporters(ExportConfig(webhook="http://127.0.0.1:9/h"),
+                          reg)
+    try:
+        assert len(out) == 1
+        assert isinstance(out[0], AlertWebhook)
+    finally:
+        for e in out:
+            e.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet acceptance: injected straggler -> one documented POST
+# ---------------------------------------------------------------------------
+
+
+def _epoch(run_id, ep, base):
+    return {"kind": "obs_epoch", "run_id": run_id, "process_index": 0,
+            "host": run_id, "epoch": ep, "step": 10 * ep, "steps": 10,
+            "step_time_mean_s": base, "step_time_p50_s": base,
+            "step_time_sample": [base + 0.0001 * i for i in range(16)],
+            "examples_per_sec": 100.0, "live_processes": 1}
+
+
+def test_straggler_fires_one_webhook_post_end_to_end():
+    """The acceptance bar: two replicas, one straggling 5x, the
+    aggregator's alert bridge fires, and exactly ONE POST with the
+    documented payload lands on a stdlib HTTP receiver."""
+    srv, got = _receiver()
+    try:
+        agg = Aggregator(straggler_factor=2.0)
+        wh = AlertWebhook(
+            WebhookTransport(
+                f"http://127.0.0.1:{srv.server_address[1]}/hook"),
+            registry=agg.registry)
+        agg.registry.add_sink(wh)
+        for ep in range(1, 4):
+            agg.ingest(_epoch("fast", ep, 0.010), stamp_time=False)
+            agg.ingest(_epoch("slow", ep, 0.050), stamp_time=False)
+        agg.emit_rollup()                    # straggler fires here
+        agg.emit_rollup()                    # latched: must NOT re-page
+        wh.close()
+        assert len(got) == 1, got
+        payload = got[0]
+        assert payload["source"] == "tpunet"
+        assert payload["kind"] == "obs_alert"
+        assert payload["reason"] == "straggler"
+        assert payload["scope"] == "fleet"
+        assert payload["stream"] == "slow/0"
+        assert payload["detail"]["factor"] > 2.0
+        assert "straggler" in payload["summary"]
+        assert wh.stats()["sent"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_regression_record_pages_too():
+    """obs_regression records page through the same sink — the
+    obs_compare --webhook path."""
+    srv, got = _receiver()
+    try:
+        reg = Registry()
+        wh = AlertWebhook(
+            WebhookTransport(
+                f"http://127.0.0.1:{srv.server_address[1]}/"),
+            registry=reg)
+        reg.add_sink(wh)
+        from tpunet.obs.history import emit_regression
+        emit_regression(reg, {"run_a": "A", "run_b": "B",
+                              "verdict": "regression",
+                              "regressions": 2, "metrics": []})
+        wh.close()
+        assert len(got) == 1
+        assert got[0]["kind"] == "obs_regression"
+        assert got[0]["reason"] == "regression"
+    finally:
+        srv.shutdown()
+        srv.server_close()

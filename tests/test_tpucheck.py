@@ -46,6 +46,8 @@ BAD_CASES = [
     ("r4_bad_popen", "R4", 1),
     ("r5_bad_missing_flag", "R5", 1),
     ("r5_bad_missing_docs", "R5", 1),
+    ("r6_bad_undocumented", "R6", 1),
+    ("r6_bad_fstring", "R6", 1),
 ]
 
 GOOD_CASES = [
@@ -59,6 +61,8 @@ GOOD_CASES = [
     ("r4_good_suppressed", "R4"),
     ("r5_good_wired", "R5"),
     ("r5_good_bool_negation", "R5"),
+    ("r6_good_documented", "R6"),
+    ("r6_good_dynamic", "R6"),
 ]
 
 

@@ -7,6 +7,7 @@ from typing import Dict, Tuple
 from tpunet.analysis.core import Rule
 from tpunet.analysis.rules.donation import DonationRule
 from tpunet.analysis.rules.drift import DriftRule
+from tpunet.analysis.rules.instruments import InstrumentRule
 from tpunet.analysis.rules.jit_effects import JitEffectsRule
 from tpunet.analysis.rules.scopes import ScopeRule
 from tpunet.analysis.rules.threads import ThreadRule
@@ -17,6 +18,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     JitEffectsRule(),
     ThreadRule(),
     DriftRule(),
+    InstrumentRule(),
 )
 
 

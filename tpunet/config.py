@@ -293,6 +293,13 @@ class ExportConfig:
     statsd: str = ""                  # "HOST:PORT" UDP statsd endpoint
     statsd_prefix: str = "tpunet"
     http: str = ""                    # line-JSON POST URL
+    # Alert webhook (tpunet/obs/export/webhook.py): POST one templated
+    # JSON payload per obs_alert / obs_crash / obs_regression record
+    # (--obs-webhook URL). Retries with backoff; exhausted pages land
+    # in the dead-letter list and the webhook_dead_letter counter.
+    webhook: str = ""
+    webhook_max_retries: int = 3
+    webhook_backoff_s: float = 0.25
     # Bounded export queue: put_nowait from the step path; overflow
     # drops (counted) rather than blocking.
     queue_size: int = 1024
@@ -676,6 +683,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="POST obs records as line-JSON to this URL "
                         "(same non-blocking queue; pair with "
                         "'scripts/obs_dashboard.py --listen PORT')")
+    p.add_argument("--obs-webhook", default=None, metavar="URL",
+                   help="POST one templated JSON payload per alert "
+                        "record (obs_alert/obs_crash/obs_regression) "
+                        "to this URL — retried with backoff, "
+                        "dead-lettered after webhook_max_retries "
+                        "(wire format in docs/metrics_schema.md)")
     p.add_argument("--obs-queue-size", type=int, default=None,
                    help="bounded export queue depth (overflow drops "
                         "records and counts them, never blocks a step)")
@@ -787,6 +800,8 @@ def config_from_args(argv=None) -> TrainConfig:
         export = dataclasses.replace(export, statsd=args.statsd)
     if args.obs_http is not None:
         export = dataclasses.replace(export, http=args.obs_http)
+    if args.obs_webhook is not None:
+        export = dataclasses.replace(export, webhook=args.obs_webhook)
     if args.obs_queue_size is not None:
         export = dataclasses.replace(export,
                                      queue_size=args.obs_queue_size)

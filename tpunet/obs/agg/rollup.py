@@ -59,7 +59,8 @@ class StreamState:
         self.records += 1
         if now is not None:
             self.last_seen = now
-        for k in ("run_id", "process_index", "host"):
+        for k in ("run_id", "process_index", "host",
+                  "config_fingerprint"):
             if k in record:
                 self.identity[k] = record[k]
         kind = record.get("kind")

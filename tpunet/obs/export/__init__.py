@@ -29,10 +29,13 @@ from __future__ import annotations
 from tpunet.obs.export.exporter import AsyncExporter, MemoryTransport
 from tpunet.obs.export.http import HttpLineTransport
 from tpunet.obs.export.statsd import StatsdTransport
+from tpunet.obs.export.webhook import (AlertWebhook, WebhookTransport,
+                                       build_payload)
 
 __all__ = [
-    "AsyncExporter", "HttpLineTransport", "MemoryTransport",
-    "StatsdTransport", "build_exporters",
+    "AlertWebhook", "AsyncExporter", "HttpLineTransport",
+    "MemoryTransport", "StatsdTransport", "WebhookTransport",
+    "build_exporters", "build_payload",
 ]
 
 
@@ -64,5 +67,13 @@ def build_exporters(cfg, registry) -> list:
         out.append(AsyncExporter(
             HttpLineTransport(cfg.http, timeout=cfg.http_timeout_s),
             name="http", queue_size=cfg.queue_size,
+            flush_timeout=cfg.flush_timeout_s, registry=registry))
+    if getattr(cfg, "webhook", ""):
+        # URL syntax validated in WebhookTransport (same fail-at-setup
+        # posture as the endpoints above).
+        out.append(AlertWebhook(
+            WebhookTransport(cfg.webhook, timeout=cfg.http_timeout_s),
+            max_retries=cfg.webhook_max_retries,
+            backoff_s=cfg.webhook_backoff_s,
             flush_timeout=cfg.flush_timeout_s, registry=registry))
     return out

@@ -51,8 +51,17 @@ class ThreadHandle:
 
     def beat(self, state: Optional[str] = None) -> None:
         """Heartbeat from the owning thread; optionally transitions
-        the idle/busy state in the same call."""
-        if state is not None:
+        the idle/busy state in the same call. State *transitions*
+        (not same-state beats) also land in the flight-recorder ring
+        — the busy/idle periods the unified timeline exporter
+        (tpunet/obs/history/timeline.py) renders as per-thread
+        tracks; one ring write per flip, nothing on same-state
+        beats."""
+        if state is not None and state != self.state:
+            self.state = state
+            from tpunet.obs import flightrec
+            flightrec.record("thread", f"{state} {self.name}")
+        elif state is not None:
             self.state = state
         self.last_beat = self._clock()
         self.beats += 1

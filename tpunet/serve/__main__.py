@@ -66,6 +66,10 @@ def build_argparser():
                    help="stream obs_serve records as statsd/UDP gauges")
     p.add_argument("--obs-http", default="", metavar="URL",
                    help="POST obs_serve records as line-JSON")
+    p.add_argument("--obs-webhook", default="", metavar="URL",
+                   help="POST one templated JSON payload per alert "
+                        "record (obs_alert/obs_crash) — wire format "
+                        "in docs/metrics_schema.md")
     p.add_argument("--run-id", default=d.run_id,
                    help="replica identity stamped on obs_serve records "
                         "(fleet rollups route by it; default "
@@ -156,11 +160,12 @@ def build_server(args):
     if metrics_dir:
         metrics_logger = MetricsLogger(metrics_dir, resume=True)
         registry.add_sink(JsonlSink(metrics_logger))
-    if args.statsd or args.obs_http:
+    if args.statsd or args.obs_http or args.obs_webhook:
         from tpunet.config import ExportConfig
         from tpunet.obs.export import build_exporters
         exporters = build_exporters(
-            ExportConfig(statsd=args.statsd, http=args.obs_http),
+            ExportConfig(statsd=args.statsd, http=args.obs_http,
+                         webhook=args.obs_webhook),
             registry)
         for exporter in exporters:
             registry.add_sink(exporter)

@@ -24,6 +24,7 @@ prefill/decode phases run under trace spans, and a periodic
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import List, Optional
@@ -38,6 +39,24 @@ from tpunet.serve.scheduler import (FINISH_CANCELLED, FINISH_DEADLINE,
 
 class PromptTooLongError(Exception):
     """Prompt exceeds the largest prefill bucket or the KV length."""
+
+
+@contextlib.contextmanager
+def _ring_span(name: str):
+    """The serve twin of the trainer's ``_RecordedSpan``: an xprof
+    trace span whose begin/end ALSO land in the flight-recorder ring
+    (the unified timeline's device phases; the crash tail's "which
+    phase was the replica in"). ``span_end`` sits in a finally so a
+    raising device call cannot leave a dangling open span for the
+    timeline to stretch to the end of the recording."""
+    from tpunet.obs import flightrec
+    from tpunet.obs.spans import span
+    flightrec.record("span", name)
+    try:
+        with span(name):
+            yield
+    finally:
+        flightrec.record("span_end", name)
 
 
 def sample_token(logits: np.ndarray, req: GenerateRequest) -> int:
@@ -277,6 +296,13 @@ class Engine:
         except Exception:
             self.registry.counter("serve_requests_rejected").inc()
             raise
+        # Request-lifecycle breadcrumb into the flight-recorder ring:
+        # submit -> prefill -> first_token -> finish become the
+        # queue/prefill/decode phases on the unified timeline
+        # (tpunet/obs/history/timeline.py). ~1-2 us each, no-op
+        # without an armed recorder.
+        from tpunet.obs import flightrec
+        flightrec.record("req", f"submit {req.id} len={req.prompt.size}")
         self.registry.counter("serve_requests_total").inc()
         self.registry.gauge("serve_queue_depth").set(self.queue.depth())
         self._wake.set()
@@ -358,7 +384,19 @@ class Engine:
         handle = self._thread_handle
         try:
             while not self._stop.is_set():
-                handle.beat("busy")
+                # Claim busy only when there is (potential) work: an
+                # empty iteration is a poll, not work, and marking it
+                # busy would (a) lie to the thread_stalled watchdog
+                # and (b) flood the flight-recorder ring with ~100
+                # busy/idle transition events per second from an idle
+                # server, evicting the request breadcrumbs the
+                # timeline exporter needs. A wedged device call always
+                # had work, so stall detection is unaffected.
+                if (self.active_slots() or self.queue.depth()
+                        or self._drain_kill.is_set()):
+                    handle.beat("busy")
+                else:
+                    handle.beat("idle")
                 did_work = self._iterate()
                 if self._draining.is_set() and self.active_slots() == 0 \
                         and self.queue.depth() == 0:
@@ -416,6 +454,8 @@ class Engine:
         QUEUE finishes before they ever reach a slot: the counters must
         reconcile (requests_total == rejected + sum(finished_*))."""
         reg = self.registry
+        from tpunet.obs import flightrec
+        flightrec.record("req", f"finish {req.id} {reason}")
         reg.counter(f"serve_finished_{reason}").inc()
         if reason in (FINISH_LENGTH, FINISH_STOP):
             reg.counter("serve_requests_completed").inc()
@@ -439,6 +479,12 @@ class Engine:
         self.registry.gauge("serve_queue_depth").set(self.queue.depth())
         if not reqs:
             return False
+        if self._thread_handle is not None:
+            # A request can land between the top-of-loop idle beat and
+            # this pop; mark busy BEFORE the prefill device call, or a
+            # wedged call would hang an officially-idle thread and the
+            # thread_stalled watchdog would never fire.
+            self._thread_handle.beat("busy")
         by_bucket = {}
         for req, slot_i in zip(reqs, free):
             by_bucket.setdefault(self.bucket_for(req.prompt.size),
@@ -455,8 +501,6 @@ class Engine:
         The padded tail writes garbage K/V beyond the prompt — masked
         invariant: a decode query at position p attends only j <= p and
         overwrites position p first, so padding is never visible."""
-        from tpunet.obs.spans import span
-
         t0 = time.perf_counter()
         toks = np.zeros((self.slots, bucket), np.int32)
         active = np.zeros((self.slots,), bool)
@@ -469,7 +513,10 @@ class Engine:
             self._active[slot_i] = _Slot(req, pos=req.prompt.size,
                                          next_token=0)
         positions = np.zeros((self.slots,), np.int32)
-        with span("tpunet/serve_prefill"):
+        from tpunet.obs import flightrec
+        for _, req in group:
+            flightrec.record("req", f"prefill {req.id}")
+        with _ring_span("tpunet/serve_prefill"):
             self._cache, logits = self._step(
                 self.variables["params"], self._cache, toks, positions,
                 active)
@@ -480,6 +527,7 @@ class Engine:
             first = sample_token(logits[slot_i, n - 1], req)
             self._active[slot_i].next_token = first
             req.push_token(first)
+            flightrec.record("req", f"first_token {req.id}")
             reg.counter("serve_tokens_total").inc()
             reg.histogram("serve_ttft_s").observe(req.ttft_s)
             self._slot_maybe_finish(slot_i, first)
@@ -511,8 +559,6 @@ class Engine:
                 if s is not None]
         if not live:
             return False
-        from tpunet.obs.spans import span
-
         t0 = time.perf_counter()
         toks = self._inactive_tok.copy()
         positions = np.zeros((self.slots,), np.int32)
@@ -521,7 +567,7 @@ class Engine:
             toks[i, 0] = slot.next_token
             positions[i] = slot.pos
             active[i] = True
-        with span("tpunet/serve_decode"):
+        with _ring_span("tpunet/serve_decode"):
             self._cache, logits = self._step(
                 self.variables["params"], self._cache, toks, positions,
                 active)

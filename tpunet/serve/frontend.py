@@ -313,7 +313,13 @@ def _make_handler(server: ServeServer):
                 except OSError:
                     pass
             except (BrokenPipeError, ConnectionResetError, OSError):
-                # Client went away mid-stream: free the slot.
+                # Client went away mid-stream: free the slot. The
+                # disconnect is a lifecycle event too — on the unified
+                # timeline a decode phase ending in "cancelled" with a
+                # client_gone mark next to it reads as the client's
+                # fault, not the engine's.
+                from tpunet.obs import flightrec
+                flightrec.record("req", f"client_gone {req.id}")
                 req.cancel()
 
         def _classify(self, body: dict) -> None:

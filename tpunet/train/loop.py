@@ -222,6 +222,16 @@ class Trainer:
             # resume keeps the persisted run_id, so the restored
             # stream continues the same fleet identity.
             resume=cfg.checkpoint.resume)
+        if self.obs.enabled:
+            # Config fingerprint joins runs of the same workload: the
+            # run-history store and cross-run regression compare
+            # (tpunet/obs/history/) only judge run N against run N-1
+            # when the fingerprints match, and BENCH artifacts join to
+            # training runs through the same hash.
+            from tpunet.obs.history import train_fingerprint
+            ident = self.obs.registry.identity()
+            self.obs.registry.set_identity(
+                **ident, config_fingerprint=train_fingerprint(cfg))
         from tpunet.models import num_params
         self.obs.set_flops_per_unit(train_flops_per_unit(
             cfg.model, cfg.data, n_params=num_params(state.params)))
