@@ -266,6 +266,53 @@ def collect_regression_records() -> list:
     return sink.records
 
 
+def collect_elastic_records(tmpdir: str) -> list:
+    """obs_elastic via both real emission paths: the agent-side
+    append (identity from the run dir, one jsonl line) and the
+    trainer-side registry emit — plus the checkpointer's
+    ckpt_io_retry obs_alert."""
+    import os
+
+    from tpunet.ckpt.orbax_io import emit_io_retry_alert
+    from tpunet.elastic import events
+    from tpunet.obs.registry import MemorySink, Registry
+    from tpunet.utils.logging import MetricsLogger
+
+    run_dir = os.path.join(tmpdir, "run")
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "run_id"), "w") as f:
+        f.write("elastic-check\n")
+    records = []
+    records.append(events.append_elastic_record(
+        run_dir, events.build_elastic_record(
+            "shrink", cause="host_lost", generation=3, old_world=2,
+            new_world=1, hosts=["h0"], lost=["h1"], step=40,
+            recovery_s=2.345)))
+    records.append(events.append_elastic_record(
+        run_dir, events.build_elastic_record(
+            "quorum_failed", cause="0 hosts announced", generation=4,
+            old_world=1)))
+    # The agent-side lines really are metrics.jsonl lines.
+    assert MetricsLogger.read_records(
+        os.path.join(run_dir, "metrics.jsonl"))
+    reg = Registry()
+    reg.set_identity(run_id="elastic-check", process_index=0, host="h")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    reg.emit("obs_elastic", events.build_elastic_record(
+        "recovered", generation=3, new_world=1,
+        old_mesh={"data": 2, "seq": 1, "pipe": 1, "model": 1},
+        new_mesh={"data": 1, "seq": 1, "pipe": 1, "model": 1},
+        epoch=2, step=40))
+    reg.emit("obs_elastic", events.build_elastic_record(
+        "evict_requested", cause="step_stall", epoch=2, step=37,
+        detail={"reason": "step_stall", "step_time_s": 1.2}))
+    emit_io_retry_alert(reg, what="save",
+                        error="chaos: injected transient save IO "
+                              "error", max_retries=3, backoff_s=0.1)
+    return records + sink.records
+
+
 def collect_agg_records() -> list:
     """obs_fleet + every fleet obs_alert reason via a two-stream
     aggregator (one straggling, one leaking, both serving)."""
@@ -317,6 +364,11 @@ def collect_agg_records() -> list:
                 "report_path": "/tmp/x.json", "crashed_pid": 1,
                 "events": 3, "stack_threads": 2, "native_ops": 5,
                 "assembled_t": 1.0})      # crash alert + crashes_total
+    agg.ingest({"kind": "obs_elastic", "run_id": "a",
+                "process_index": 0, "event": "shrink",
+                "severity": "warn", "cause": "host_lost",
+                "generation": 2, "old_world": 2, "new_world": 1,
+                "time": 1234.5})          # elastic_* rollup fields
     agg.emit_rollup()           # straggler + mem_growth + rules + crash
     clock.t += 100.0
     agg.emit_rollup()           # stream_stale for every stream
@@ -352,6 +404,8 @@ def main() -> int:
     records += collect_serve_records()
     records += collect_agg_records()
     records += collect_regression_records()
+    with tempfile.TemporaryDirectory() as tmp:
+        records += collect_elastic_records(tmp)
     emitted_kinds = sorted({r.get("kind", PLAIN) for r in records})
     bad = undocumented(records, kinds, fields, global_fields)
     if bad:

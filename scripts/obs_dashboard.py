@@ -420,6 +420,13 @@ def render_fleet_terminal(rollup: dict, ages: dict, source: str,
         head.append(f"queue {rollup['serve_queue_depth']}")
     if rollup.get("crashes_total"):
         head.append(f"CRASHES {rollup['crashes_total']}")
+    if rollup.get("elastic_events_total"):
+        last = rollup.get("elastic_last_event", "")
+        gen = rollup.get("elastic_generation")
+        head.append(f"ELASTIC {rollup['elastic_events_total']}"
+                    + (f" (last {last}"
+                       + (f", gen {gen}" if gen is not None else "")
+                       + ")" if last else ""))
     out.append("  ".join(head))
     out.append("")
 
@@ -513,6 +520,10 @@ def render_fleet_html(rollup: dict, streams, source: str,
     tile(rollup.get("alerts_total", 0) + len(alerts), "alerts")
     if rollup.get("crashes_total"):
         tile(rollup["crashes_total"], "crashes")
+    if rollup.get("elastic_events_total"):
+        last = rollup.get("elastic_last_event", "")
+        tile(rollup["elastic_events_total"],
+             f"elastic events{f' (last {last})' if last else ''}")
 
     cards = []
     # Per-stream step-time trend: one line per stream, shared y scale.

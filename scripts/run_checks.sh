@@ -85,6 +85,7 @@ run_gate "sanitizer-smoke" python scripts/check_sanitizers.py --smoke
 if [ "$SLOW" = 1 ]; then
   run_gate "sanitizers-full" python scripts/check_sanitizers.py
   run_gate "obs-overhead" python scripts/check_obs_overhead.py
+  run_gate "chaos-smoke" python scripts/chaos_smoke.py
 fi
 
 echo

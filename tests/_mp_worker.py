@@ -198,6 +198,14 @@ def main():
     coordinator, num_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
     ckpt_dir = sys.argv[5] if len(sys.argv) > 5 else None
+    # Cross-process CPU collectives need an explicit implementation on
+    # this jax (same fix as tpunet/parallel/dist.py): without gloo the
+    # first cross-controller psum raises "Multiprocess computations
+    # aren't implemented on the CPU backend".
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_procs,

@@ -1,0 +1,9 @@
+# tpucheck R7 fixture (bad): the producer's NAME escapes R1's
+# restore/load heuristic, but its return is an IO-origin value — only
+# the cross-module summary sees it. Parsed only, never imported.
+import pickle
+
+
+def grab_weights(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)

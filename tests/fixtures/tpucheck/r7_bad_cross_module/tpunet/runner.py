@@ -1,0 +1,16 @@
+# tpucheck R7 fixture (bad): donating the cross-module IO-tainted
+# value — the elastic re-mesh restore-path shape with the
+# re-materialization missing.
+import jax
+
+from tpunet.io_helpers import grab_weights
+
+
+def _step(state, batch):
+    return state
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+weights = grab_weights("weights.pkl")
+step(weights, None)

@@ -1,0 +1,13 @@
+# tpucheck R7 fixture (bad, transitive): the taint crosses TWO
+# project functions before reaching the donated call — the fixpoint
+# summary pass must propagate it through the wrapper.
+import pickle
+
+
+def grab_weights(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def fetch_bundle(path):
+    return grab_weights(path)

@@ -1,0 +1,15 @@
+# tpucheck R7 fixture (bad, transitive): the donated value came
+# through a wrapper of a wrapper of pickle.load.
+import jax
+
+from tpunet.io_helpers import fetch_bundle
+
+
+def _step(state, batch):
+    return state
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+bundle = fetch_bundle("weights.pkl")
+step(bundle, None)

@@ -1,0 +1,8 @@
+# tpucheck R7 fixture (good, call-site copy): the producer IS
+# tainted, but the consumer re-materializes before donating.
+import pickle
+
+
+def grab_weights(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)

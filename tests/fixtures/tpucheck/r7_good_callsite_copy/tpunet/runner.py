@@ -1,0 +1,17 @@
+# tpucheck R7 fixture (good): jnp.copy at the call site clears the
+# cross-module taint before the donated position — the established
+# PR-7 discipline, applied by the consumer.
+import jax
+import jax.numpy as jnp
+
+from tpunet.io_helpers import grab_weights
+
+
+def _step(state, batch):
+    return state
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+weights = jnp.copy(grab_weights("weights.pkl"))
+step(weights, None)

@@ -1,0 +1,14 @@
+# tpucheck R7 fixture (good): the producer RE-MATERIALIZES before
+# returning — its summary is clean, so donating its result is safe.
+# This is the precision R1's name heuristic cannot express (it would
+# need a baseline entry); the cross-module summary proves it.
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+
+def grab_weights(path):
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    return jax.tree_util.tree_map(jnp.copy, raw)

@@ -1,0 +1,15 @@
+# tpucheck R7 fixture (good): the producer re-materializes, so this
+# donated call is clean without any call-site copy.
+import jax
+
+from tpunet.io_helpers import grab_weights
+
+
+def _step(state, batch):
+    return state
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+weights = grab_weights("weights.pkl")
+step(weights, None)

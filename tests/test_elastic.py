@@ -1,0 +1,569 @@
+"""Elastic grow/shrink training (tpunet/elastic/): chaos spec +
+injection hooks, filesystem rendezvous, checkpoint IO retry, agent
+supervision — and the tier-1 end-to-end scenarios the ROADMAP asked
+for: a 2-process gang loses one host to injected SIGKILL mid-epoch,
+the survivor re-meshes dp 2->1 and finishes under the original
+run_id; and a kill mid-checkpoint-write restarts from the previous
+INTACT checkpoint (no torn-state acceptance)."""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpunet.elastic import chaos as chaos_mod
+from tpunet.elastic import events
+from tpunet.elastic.agent import (EXIT_DONE, EXIT_QUORUM,
+                                  EXIT_RESTARTS, AgentConfig,
+                                  ElasticAgent)
+from tpunet.elastic.chaos import Chaos, ChaosSpecError
+from tpunet.elastic.rendezvous import QuorumError, Rendezvous
+from tpunet.utils.logging import MetricsLogger
+
+# The e2e legs share ONE set of child-env/train-argv helpers with the
+# slow chaos matrix (scripts/chaos_smoke.py) so the tier-1 legs can
+# never drift from the matrix they mirror.
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "scripts"))
+try:
+    import chaos_smoke as _smoke
+finally:
+    sys.path.pop(0)
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    yield
+    chaos_mod.clear()
+
+
+# ---------------------------------------------------------------- chaos
+
+
+def test_chaos_parse_and_render():
+    c = Chaos.parse("kill@step=5; slow@step=3:delay=0.5:steps=2 ;"
+                    "ioerr@save=1:fails=2:host=1")
+    assert len(c.events) == 3
+    assert "kill@step=5" in c.render()
+
+
+@pytest.mark.parametrize("bad", [
+    "", "kill", "kill@banana=1", "slow@step=3", "kill@step=x",
+    "slow@prob=0.5:delay=1", "slow@prob=2:delay=1:seed=1",
+    "ioerr@save=1:bogus=2", "sigterm@step",
+])
+def test_chaos_parse_errors(bad):
+    with pytest.raises(ChaosSpecError):
+        Chaos.parse(bad)
+
+
+def test_chaos_kill_fires_once_on_addressed_step_and_host():
+    calls = []
+    c = Chaos.parse("kill@step=3:host=1", process_index=1,
+                    kill=lambda pid, sig: calls.append(sig))
+    for s in range(6):
+        c.step(s)
+    assert calls == [signal.SIGKILL]  # step 3 only, once
+    other = Chaos.parse("kill@step=3:host=1", process_index=0,
+                        kill=lambda pid, sig: calls.append(sig))
+    for s in range(6):
+        other.step(s)
+    assert calls == [signal.SIGKILL]  # host filter: nothing new
+
+
+def test_chaos_generation_scope():
+    calls = []
+    fired = Chaos.parse("kill@step=1:gen=1", generation=1,
+                        kill=lambda pid, sig: calls.append(sig))
+    fired.step(1)
+    assert calls == [signal.SIGKILL]
+    held = Chaos.parse("kill@step=1:gen=0", generation=1,
+                       kill=lambda pid, sig: calls.append(sig))
+    held.step(1)
+    assert calls == [signal.SIGKILL]  # gen filter: nothing new
+
+
+def test_chaos_slow_window_and_seeded_prob():
+    sleeps = []
+    c = Chaos.parse("slow@step=4:delay=0.25:steps=3",
+                    sleep=lambda s: sleeps.append(s))
+    for s in range(10):
+        c.step(s)
+    assert sleeps == [0.25, 0.25, 0.25]  # steps 4, 5, 6
+
+    def fired_steps(seed):
+        out, slept = [], []
+        c = Chaos.parse(f"slow@prob=0.5:delay=0.1:seed={seed}",
+                        sleep=lambda s: slept.append(s))
+        for s in range(32):
+            before = len(slept)
+            c.step(s)
+            if len(slept) > before:
+                out.append(s)
+        return out
+
+    a, b = fired_steps(7), fired_steps(7)
+    assert a == b and 0 < len(a) < 32  # seeded => reproducible
+    assert fired_steps(8) != a
+
+
+def test_chaos_sigterm_escalation_second_signal():
+    got = []
+    seen_two = threading.Event()
+
+    def rec(pid, sig):
+        got.append(sig)
+        if len(got) >= 2:
+            seen_two.set()
+
+    c = Chaos.parse("sigterm@step=2:again=0.01", kill=rec)
+    c.step(2)
+    assert got[0] == signal.SIGTERM
+    assert seen_two.wait(timeout=5.0), "second SIGTERM never fired"
+    assert got[1] == signal.SIGTERM
+
+
+def test_chaos_ioerr_save_and_restore_attempts():
+    c = Chaos.parse("ioerr@save=2:fails=2;ioerr@restore=1")
+    c.save_attempt(1, 0)                       # other ordinal: clean
+    with pytest.raises(OSError):
+        c.save_attempt(2, 0)
+    with pytest.raises(OSError):
+        c.save_attempt(2, 1)
+    c.save_attempt(2, 2)                       # past fails: clean
+    with pytest.raises(OSError):
+        c.restore_attempt(1, 0)
+    c.restore_attempt(1, 1)
+
+
+def test_elastic_data_axis_and_mesh_dict():
+    from tpunet.config import MeshConfig
+    from tpunet.parallel.mesh import (elastic_data_axis, make_mesh,
+                                      mesh_shape_dict)
+    assert elastic_data_axis(MeshConfig(), 4) == 4
+    assert elastic_data_axis(MeshConfig(model=2), 4) == 2
+    assert elastic_data_axis(None, 1) == 1
+    with pytest.raises(ValueError, match="cannot shrink"):
+        # seq/pipe/model are workload topology: a world below the
+        # model-parallel footprint is a quorum failure, not a mesh.
+        elastic_data_axis(MeshConfig(model=2, pipe=2), 2)
+    mesh = make_mesh(MeshConfig(data=2))
+    assert mesh_shape_dict(mesh) == {"data": 2, "seq": 1, "pipe": 1,
+                                     "model": 1}
+
+
+# ----------------------------------------------------------- rendezvous
+
+
+def test_rendezvous_gather_ranks_and_departure(tmp_path):
+    a = Rendezvous(str(tmp_path), "a", settle_s=0.1, timeout_s=5.0)
+    b = Rendezvous(str(tmp_path), "b", settle_s=0.1, timeout_s=5.0)
+    a.announce(0, {"port": 1, "ckpt_step": None})
+    b.announce(0, {"port": 2})
+    members = a.gather(0)
+    assert [h for h, _ in members] == ["a", "b"]  # deterministic rank
+    assert members[0][1]["port"] == 1
+    assert a.latest_generation() == 0
+    b.mark_gone()
+    assert set(a.members(0)) == {"a"}
+    b2 = Rendezvous(str(tmp_path), "c", settle_s=0.1, timeout_s=5.0)
+    b2.announce(4, {})
+    assert a.latest_generation() == 4
+
+
+def test_rendezvous_quorum_timeout(tmp_path):
+    solo = Rendezvous(str(tmp_path), "a", min_hosts=2, settle_s=0.05,
+                      timeout_s=0.3)
+    solo.announce(0, {})
+    with pytest.raises(QuorumError, match="cannot form quorum"):
+        solo.gather(0)
+
+
+def test_rendezvous_heartbeats_and_join(tmp_path):
+    a = Rendezvous(str(tmp_path), "a")
+    b = Rendezvous(str(tmp_path), "b")
+    a.heartbeat()
+    b.heartbeat()
+    assert a.stale_peers(["a", "b"], dead_after_s=60.0) == set()
+    old = time.time() - 120.0
+    os.utime(os.path.join(str(tmp_path), "hb", "b"), (old, old))
+    assert a.stale_peers(["a", "b"], dead_after_s=60.0) == {"b"}
+    assert a.stale_peers(["a", "ghost"], dead_after_s=60.0) == {"ghost"}
+    b.request_join()
+    assert a.join_requests() == {"b"}
+    a.clear_join("b")
+    assert a.join_requests() == set()
+
+
+# --------------------------------------------------------------- events
+
+
+def test_elastic_records_and_markers(tmp_path):
+    run_dir = str(tmp_path)
+    with open(os.path.join(run_dir, "run_id"), "w") as f:
+        f.write("run-xyz\n")
+    rec = events.append_elastic_record(run_dir, events.build_elastic_record(
+        "shrink", cause="host_lost", generation=2, old_world=2,
+        new_world=1, hosts=["h0"], lost=["h1"], recovery_s=1.25))
+    assert rec["kind"] == "obs_elastic" and rec["run_id"] == "run-xyz"
+    parsed = MetricsLogger.read_records(
+        os.path.join(run_dir, "metrics.jsonl"))
+    assert parsed[0]["event"] == "shrink"
+    assert parsed[0]["recovery_s"] == 1.25
+    with pytest.raises(ValueError, match="unknown elastic event"):
+        events.build_elastic_record("explode")
+    assert events.build_elastic_record(
+        "quorum_failed")["severity"] == "fatal"
+
+    assert not events.is_done(run_dir)
+    events.mark_done(run_dir)
+    assert events.is_done(run_dir)
+    assert events.read_evict_marker(run_dir) is None
+    events.write_evict_marker(run_dir, process_index=1, host="h1",
+                              reason="step_stall", detail={"x": 1})
+    marker = events.read_evict_marker(run_dir)
+    assert marker["host"] == "h1" and marker["process_index"] == 1
+    events.clear_evict_marker(run_dir)
+    assert events.read_evict_marker(run_dir) is None
+    events.write_mesh(run_dir, {"data": 2, "seq": 1})
+    assert events.read_mesh(run_dir) == {"data": 2, "seq": 1}
+
+
+# ------------------------------------------------- checkpoint IO retry
+
+
+def _obs_with_sink(tmp_path):
+    from tpunet.config import ObsConfig
+    from tpunet.obs import Observability
+    from tpunet.obs.registry import MemorySink
+    obs = Observability(ObsConfig(flightrec=False),
+                        checkpoint_dir=str(tmp_path))
+    sink = MemorySink()
+    obs.add_sink(sink)
+    return obs, sink
+
+
+def test_ckpt_transient_save_error_retried_with_one_alert(tmp_path):
+    from tpunet.ckpt import Checkpointer
+    from tpunet.config import CheckpointConfig
+    obs, sink = _obs_with_sink(tmp_path)
+    ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path)),
+                        obs=obs)
+    chaos_mod._CURRENT = Chaos.parse("ioerr@save=1:fails=2")
+    try:
+        ckpt.save_state(1, {"x": np.arange(8, dtype=np.int32)})
+        assert ckpt.wait() is True
+    finally:
+        ckpt.close()
+        obs.close()
+    assert obs.registry.counter("ckpt_io_retries").value == 2
+    bursts = [r for r in sink.records
+              if r.get("kind") == "obs_alert"
+              and r.get("reason") == "ckpt_io_retry"]
+    assert len(bursts) == 1          # one loud alert per burst
+    assert bursts[0]["what"] == "save"
+    # ... and the save actually landed despite the two failures.
+    restored = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path))).restore_state(
+        {"x": np.zeros(8, dtype=np.int32)})
+    assert restored is not None
+    np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                  np.arange(8))
+
+
+def test_ckpt_exhausted_retries_propagate(tmp_path):
+    from tpunet.ckpt import Checkpointer
+    from tpunet.config import CheckpointConfig
+    ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path)))
+    chaos_mod._CURRENT = Chaos.parse("ioerr@save=1:fails=9")
+    ckpt.save_state(1, {"x": np.arange(4, dtype=np.int32)})
+    with pytest.raises(OSError, match="chaos"):
+        ckpt.wait()
+    ckpt.abandon()   # unblock close on the failed worker
+
+
+def test_ckpt_transient_restore_error_retried(tmp_path):
+    from tpunet.ckpt import Checkpointer
+    from tpunet.config import CheckpointConfig
+    obs, sink = _obs_with_sink(tmp_path)
+    ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path)),
+                        obs=obs)
+    try:
+        ckpt.save_state(1, {"x": np.arange(4, dtype=np.int32)})
+        ckpt.wait()
+        chaos_mod._CURRENT = Chaos.parse("ioerr@restore=1:fails=1")
+        restored = ckpt.restore_state(
+            {"x": np.zeros(4, dtype=np.int32)})
+        assert restored is not None
+        assert obs.registry.counter("ckpt_io_retries").value == 1
+    finally:
+        ckpt.close()
+        obs.close()
+
+
+def test_ckpt_grace_timeout_goes_permanently_nonblocking(tmp_path):
+    """A timed-out bounded wait must not be followed by an unbounded
+    one: main's finally runs close(), and blocking there holds the
+    process past the platform's SIGKILL (the grace window's whole
+    point)."""
+    from tpunet.ckpt import Checkpointer
+    from tpunet.config import CheckpointConfig
+    ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path)))
+    # Three injected failures keep the worker busy in retry/backoff
+    # (~0.7s) — far longer than the 50ms grace budget below.
+    chaos_mod._CURRENT = Chaos.parse("ioerr@save=1:fails=3")
+    ckpt.save_state(1, {"x": np.arange(4, dtype=np.int32)})
+    assert ckpt.wait(timeout=0.05) is False
+    t0 = time.monotonic()
+    assert ckpt.wait() is False     # abandoned: no unbounded re-wait
+    ckpt.close()                    # ... and close() is a no-op too
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_ckpt_abandon_makes_wait_and_close_nonblocking(tmp_path):
+    from tpunet.ckpt import Checkpointer
+    from tpunet.config import CheckpointConfig
+    ckpt = Checkpointer(CheckpointConfig(directory=str(tmp_path)))
+    ckpt.save_state(1, {"x": np.arange(4, dtype=np.int32)})
+    ckpt.abandon()
+    t0 = time.monotonic()
+    assert ckpt.wait() is False
+    ckpt.close()
+    assert time.monotonic() - t0 < 1.0
+
+
+# ------------------------------------------------------- agent (dummy)
+
+
+def _agent(tmp_path, script_body, host="h0", **kw):
+    run_dir = os.path.join(str(tmp_path), "run")
+    os.makedirs(run_dir, exist_ok=True)
+    cmd = [sys.executable, "-c", script_body, run_dir]
+    cfg = AgentConfig(
+        run_dir=run_dir, rdzv_dir=os.path.join(str(tmp_path), "rdzv"),
+        host_id=host, command=cmd, settle_s=0.05, timeout_s=5.0,
+        beat_s=0.05, grace_s=1.0, **kw)
+    return ElasticAgent(cfg), run_dir
+
+
+DONE_CHILD = """
+import os, sys
+d = os.path.join(sys.argv[-1], "elastic")
+os.makedirs(d, exist_ok=True)
+open(os.path.join(d, "done"), "w").write("x")
+"""
+
+ARGV_CHILD = """
+import json, os, sys
+run = [a for a in sys.argv[1:] if a != "--resume"][-1]
+with open(os.path.join(run, "argv.json"), "w") as f:
+    json.dump(sys.argv[1:], f)
+d = os.path.join(run, "elastic")
+os.makedirs(d, exist_ok=True)
+open(os.path.join(d, "done"), "w").write("x")
+"""
+
+
+def test_agent_done_marker_stops_relaunching(tmp_path):
+    agent, run_dir = _agent(tmp_path, DONE_CHILD)
+    assert agent.run() == EXIT_DONE
+    # One generation, no membership-change records.
+    assert not os.path.exists(os.path.join(run_dir, "metrics.jsonl"))
+
+
+def test_agent_restarts_then_gives_up_and_marks_gone(tmp_path):
+    agent, run_dir = _agent(tmp_path, "import sys; sys.exit(1)",
+                            max_restarts=1)
+    assert agent.run() == EXIT_RESTARTS
+    records = MetricsLogger.read_records(
+        os.path.join(run_dir, "metrics.jsonl"))
+    restarts = [r for r in records if r.get("event") == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["cause"] == "failed"
+    assert restarts[0]["old_world"] == restarts[0]["new_world"] == 1
+    assert restarts[0]["recovery_s"] >= 0
+    assert "h0" in agent.rdzv.gone()
+
+
+def test_agent_quorum_failure_degrades_cleanly(tmp_path):
+    agent, run_dir = _agent(tmp_path, DONE_CHILD, min_hosts=2)
+    agent.rdzv.timeout_s = 0.3
+    assert agent.run() == EXIT_QUORUM
+    records = MetricsLogger.read_records(
+        os.path.join(run_dir, "metrics.jsonl"))
+    assert [r["event"] for r in records] == ["quorum_failed"]
+    assert records[0]["severity"] == "fatal"
+
+
+def test_agent_appends_resume_once_state_exists(tmp_path):
+    agent, run_dir = _agent(tmp_path, ARGV_CHILD)
+    assert agent.run() == EXIT_DONE
+    with open(os.path.join(run_dir, "argv.json")) as f:
+        assert "--resume" not in json.load(f)
+    # A prior incarnation's run_id makes every later launch a resume.
+    with open(os.path.join(run_dir, "run_id"), "w") as f:
+        f.write("abc\n")
+    os.unlink(os.path.join(run_dir, "elastic", "done"))
+    agent2, _ = _agent(tmp_path, ARGV_CHILD, host="h0")
+    assert agent2.run() == EXIT_DONE
+    with open(os.path.join(run_dir, "argv.json")) as f:
+        assert "--resume" in json.load(f)
+
+
+# ------------------------------------------------------ e2e (tier-1)
+
+
+_child_env = _smoke._child_env
+_train_cmd = _smoke._train_cmd
+
+
+def _read_run(run_dir):
+    records = MetricsLogger.read_records(
+        os.path.join(run_dir, "metrics.jsonl"))
+    with open(os.path.join(run_dir, "run_id")) as f:
+        run_id = f.read().strip()
+    return records, run_id
+
+
+def test_elastic_shrink_on_sigkill_mid_step(tmp_path):
+    """THE acceptance scenario: a 2-process CPU gang loses host 1 to
+    an injected SIGKILL mid-epoch-2; the survivor re-meshes dp 2->1,
+    restores the epoch-1 checkpoint, finishes training, and the
+    metrics stream carries obs_elastic shrink + recovered records
+    under the original run_id."""
+    run_dir = str(tmp_path / "run")
+    rdzv_dir = str(tmp_path / "rdzv")
+    # slow@step=2 (both hosts, 2s) gives the async epoch-1 save time
+    # to COMMIT before host 1 dies entering step 3 (epoch 2's second
+    # step); gen=0 keeps the faults out of the resumed incarnation.
+    cmd = _train_cmd(
+        run_dir, "slow@step=2:delay=2:gen=0;kill@step=3:host=1:gen=0")
+    agents = {
+        # Survivor: absorbs its own wedged-child kill via the peer
+        # path (no restart budget consumed) — budget is for failures.
+        "h0": AgentConfig(run_dir=run_dir, rdzv_dir=rdzv_dir,
+                          host_id="h0", command=cmd, max_restarts=2,
+                          settle_s=0.4, timeout_s=120.0, beat_s=0.1,
+                          dead_after_s=10.0, grace_s=3.0,
+                          env=_child_env()),
+        # Doomed host: any child failure is host death.
+        "h1": AgentConfig(run_dir=run_dir, rdzv_dir=rdzv_dir,
+                          host_id="h1", command=cmd, max_restarts=0,
+                          settle_s=0.4, timeout_s=120.0, beat_s=0.1,
+                          dead_after_s=10.0, grace_s=3.0,
+                          env=_child_env()),
+    }
+    rcs = {}
+    threads = []
+    for host, cfg in agents.items():
+        t = threading.Thread(
+            target=lambda h=host, c=cfg: rcs.__setitem__(
+                h, ElasticAgent(c).run()),
+            name=f"agent-{host}", daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=420.0)
+        assert not t.is_alive(), "elastic gang did not converge"
+    assert rcs["h1"] == EXIT_RESTARTS      # host death, left the pod
+    assert rcs["h0"] == EXIT_DONE          # survivor finished the run
+    assert events.is_done(run_dir)
+
+    records, run_id = _read_run(run_dir)
+    assert run_id
+    # ONE stream: every identity-stamped record carries the original
+    # run_id (training rows, obs rows, and the agent's elastic rows).
+    for r in records:
+        if "run_id" in r:
+            assert r["run_id"] == run_id
+    elastic = [r for r in records if r.get("kind") == "obs_elastic"]
+    shrinks = [r for r in elastic if r["event"] == "shrink"]
+    assert len(shrinks) == 1
+    assert shrinks[0]["old_world"] == 2
+    assert shrinks[0]["new_world"] == 1
+    assert shrinks[0]["lost"] == ["h1"]
+    assert shrinks[0]["recovery_s"] > 0
+    recovered = [r for r in elastic if r["event"] == "recovered"]
+    assert recovered, "re-meshed trainer never stamped its recovery"
+    rec = recovered[-1]
+    assert rec["new_mesh"]["data"] == 1          # dp 2 -> 1
+    assert rec["old_mesh"]["data"] == 2
+    assert rec["generation"] >= 1
+    # Restored from the last checkpoint (epoch 1 complete -> resumes
+    # at epoch 2), not from scratch.
+    assert rec["epoch"] == 2
+    # The injected SIGKILL left complete flight-recorder forensics
+    # for the dead host (process 1): the watcher survived the kill
+    # and assembled a full report. (No p1 successor ever runs, so
+    # this is the artifact, not an obs_crash record — the survivor's
+    # own child died CLEANLY: gloo surfaces the dead peer as an
+    # error, and the clean close leaves no p0 report.)
+    import glob
+    reports = glob.glob(os.path.join(run_dir, "flightrec",
+                                     "crash_report.p1*"))
+    assert reports, "no crash report for the SIGKILLed host"
+    with open(reports[0]) as f:
+        report = json.load(f)
+    assert report["cause"] == "died-without-fatal-signal"  # SIGKILL
+    assert report["events"] and report["stacks"]
+    # Training finished: the final epoch's plain record exists.
+    plain = [r for r in records if "kind" not in r]
+    epochs_seen = [r["epoch"] for r in plain if "epoch" in r]
+    assert max(epochs_seen) == 3
+    assert set(epochs_seen) >= {1, 2, 3}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("leg", ["sigterm_grace", "slow_host_evict"])
+def test_chaos_matrix_slow_legs(tmp_path, leg):
+    """The two chaos-matrix legs tier-1 does not cover: SIGTERM with
+    a grace window (partial save + resumed relaunch) and the
+    proactive slow-host checkpoint-and-evict (scripts/chaos_smoke.py
+    runs all four under run_checks.sh --slow)."""
+    _smoke.LEGS[leg](str(tmp_path))
+
+
+def test_elastic_restart_after_kill_mid_ckpt_write(tmp_path):
+    """Kill mid-checkpoint-write: the epoch-2 save's orbax write is
+    dispatched and then SIGKILLed before commit. The relaunched run
+    must restore the PREVIOUS intact checkpoint (epoch 1) — a torn,
+    uncommitted step directory is never accepted — and finish."""
+    run_dir = str(tmp_path / "run")
+    # slow@step=8 pins epoch 3 (steps 8-11 at 4 steps/epoch) while the
+    # background writer reaches save #2 and the injected SIGKILL lands
+    # — the child deterministically dies MID-RUN with the epoch-2
+    # write in flight, not after a too-fast run already finished.
+    agent = ElasticAgent(AgentConfig(
+        run_dir=run_dir, rdzv_dir=str(tmp_path / "rdzv"),
+        host_id="h0",
+        command=_train_cmd(
+            run_dir,
+            "kill@ckpt=2:gen=0;slow@step=8:delay=3:steps=4:gen=0"),
+        max_restarts=1, settle_s=0.2, timeout_s=60.0, beat_s=0.1,
+        grace_s=2.0, env=_child_env()))
+    assert agent.run() == EXIT_DONE
+    assert events.is_done(run_dir)
+
+    records, run_id = _read_run(run_dir)
+    elastic = [r for r in records if r.get("kind") == "obs_elastic"]
+    restarts = [r for r in elastic if r["event"] == "restart"]
+    assert len(restarts) == 1 and restarts[0]["cause"] == "failed"
+    recovered = [r for r in elastic if r["event"] == "recovered"]
+    assert recovered
+    # epoch-2's save was torn: the resume restored epoch 1 and
+    # re-ran epoch 2 (no torn-state acceptance).
+    assert recovered[-1]["epoch"] == 2
+    plain = [r for r in records if "kind" not in r]
+    epochs_seen = [r["epoch"] for r in plain if "epoch" in r]
+    # gen0 wrote [1, 2] (the epoch-2 row lands before its save),
+    # gen1 re-ran 2 and finished 3.
+    assert sorted(epochs_seen) == [1, 2, 2, 3]
+    assert any(r.get("kind") == "obs_crash" for r in records)
+    for r in records:
+        if "run_id" in r:
+            assert r["run_id"] == run_id

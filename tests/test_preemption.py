@@ -23,6 +23,75 @@ def test_guard_catches_signal_and_restores_handler():
     assert signal.getsignal(signal.SIGUSR1) == before
 
 
+def test_second_signal_escalates():
+    """A repeat SIGTERM inside the grace window used to be silently
+    absorbed by the already-set flag; now it escalates."""
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,))
+    with guard:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert guard.requested and not guard.escalated
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert guard.escalated
+
+
+def test_programmatic_request_is_idempotent_unless_escalating():
+    guard = PreemptionGuard()
+    guard.request()
+    guard.request()   # the cross-host stop agreement re-requests
+    assert guard.requested and not guard.escalated
+    guard.request(escalate=True)
+    assert guard.escalated
+
+
+def test_deadline_remaining_budget():
+    clock = [100.0]
+    guard = PreemptionGuard(deadline_s=30.0, clock=lambda: clock[0])
+    assert guard.remaining() is None       # not yet requested
+    guard.request()
+    assert guard.remaining() == 30.0
+    clock[0] += 12.5
+    assert guard.remaining() == 17.5
+    clock[0] += 100.0
+    assert guard.remaining() == 0.0        # clamped, never negative
+    # No configured deadline -> no budget, even when requested.
+    unbounded = PreemptionGuard()
+    unbounded.request()
+    assert unbounded.remaining() is None
+
+
+def test_escalated_preemption_abandons_checkpoint(tmp_path):
+    """Second signal during the grace window: best-effort abandon —
+    no save, no durability wait, immediate exit path."""
+    trainer = Trainer(_cfg(tmp_path))
+    real_epoch = trainer.train_one_epoch
+
+    def epoch_then_double_preempt(epoch):
+        m = real_epoch(epoch)
+        trainer.guard.request()
+        trainer.guard.request(escalate=True)   # the second SIGTERM
+        return m
+
+    trainer.train_one_epoch = epoch_then_double_preempt
+    t0 = __import__("time").monotonic()
+    try:
+        history = trainer.train()
+    finally:
+        trainer.close()
+    assert history == []
+    # Checkpoint work was ABANDONED: no state directory was written
+    # and close() returned without blocking on durability.
+    assert not os.path.isdir(os.path.join(str(tmp_path), "state"))
+    assert __import__("time").monotonic() - t0 < 60.0
+    # ... and no partial row either (the escalated exit skips the
+    # whole preemption-save bookkeeping).
+    metrics = os.path.join(str(tmp_path), "metrics.jsonl")
+    rows = []
+    if os.path.exists(metrics):   # lazily created on first row
+        with open(metrics) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+    assert not [r for r in rows if r.get("partial")]
+
+
 def _cfg(tmp_path, epochs=3):
     return TrainConfig(
         epochs=epochs,

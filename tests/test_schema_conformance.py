@@ -26,7 +26,7 @@ def test_every_emitted_kind_and_field_is_documented(capsys):
     # The harness actually exercised every layer.
     assert "obs_epoch" in out.out and "obs_serve" in out.out \
         and "obs_fleet" in out.out and "obs_alert" in out.out \
-        and "obs_crash" in out.out
+        and "obs_crash" in out.out and "obs_elastic" in out.out
 
 
 def test_thread_stalled_and_crash_reasons_emitted(tmp_path):
@@ -48,6 +48,29 @@ def test_thread_stalled_and_crash_reasons_emitted(tmp_path):
     assert "crash" in fleet_reasons
     rollups = [r for r in agg_records if r.get("kind") == "obs_fleet"]
     assert any(r.get("crashes_total") for r in rollups)
+
+
+def test_elastic_and_ckpt_io_paths_emitted(tmp_path):
+    """obs_elastic flows through both real emitters (agent jsonl
+    append + trainer registry emit) and the ckpt_io_retry alert
+    fires; the fleet side rolls elastic events up."""
+    checker = _import_checker()
+    records = checker.collect_elastic_records(str(tmp_path))
+    events = {r.get("event") for r in records
+              if r.get("kind") == "obs_elastic"}
+    assert {"shrink", "quorum_failed", "recovered",
+            "evict_requested"} <= events
+    reasons = {r.get("reason") for r in records
+               if r.get("kind") == "obs_alert"}
+    assert "ckpt_io_retry" in reasons
+    # Every record carries the run identity (the original run_id).
+    for r in records:
+        assert r.get("run_id") == "elastic-check"
+    rollups = [r for r in checker.collect_agg_records()
+               if r.get("kind") == "obs_fleet"]
+    assert any(r.get("elastic_events_total") for r in rollups)
+    assert any(r.get("elastic_last_event") == "shrink"
+               for r in rollups)
 
 
 def test_checker_catches_drift():

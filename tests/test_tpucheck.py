@@ -48,6 +48,8 @@ BAD_CASES = [
     ("r5_bad_missing_docs", "R5", 1),
     ("r6_bad_undocumented", "R6", 1),
     ("r6_bad_fstring", "R6", 1),
+    ("r7_bad_cross_module", "R7", 1),
+    ("r7_bad_transitive", "R7", 1),
 ]
 
 GOOD_CASES = [
@@ -63,6 +65,8 @@ GOOD_CASES = [
     ("r5_good_bool_negation", "R5"),
     ("r6_good_documented", "R6"),
     ("r6_good_dynamic", "R6"),
+    ("r7_good_producer_copy", "R7"),
+    ("r7_good_callsite_copy", "R7"),
 ]
 
 

@@ -11,6 +11,7 @@ from tpunet.analysis.rules.instruments import InstrumentRule
 from tpunet.analysis.rules.jit_effects import JitEffectsRule
 from tpunet.analysis.rules.scopes import ScopeRule
 from tpunet.analysis.rules.threads import ThreadRule
+from tpunet.analysis.rules.xmodule import CrossModuleDonationRule
 
 ALL_RULES: Tuple[Rule, ...] = (
     DonationRule(),
@@ -19,6 +20,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     ThreadRule(),
     DriftRule(),
     InstrumentRule(),
+    CrossModuleDonationRule(),
 )
 
 

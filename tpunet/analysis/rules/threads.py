@@ -35,8 +35,13 @@ _SPAWN_SUFFIXES = {"threading.Thread": "thread", "Thread": "thread",
                    "subprocess.Popen": "process", "Popen": "process"}
 
 #: Paths (prefix match on the repo-relative posix path) where spawns
-#: are the registry's own machinery.
-_ALLOWLIST_PREFIXES = ("tpunet/obs/flightrec/",)
+#: are the registry's own machinery — or, for the elastic agent, a
+#: deliberately jax-free supervisor process: the agent launches and
+#: reaps the trainer children that HOST the registry; it has no obs
+#: runtime of its own to register with, and its supervise loop (poll
+#: + heartbeat files) is its own inventory.
+_ALLOWLIST_PREFIXES = ("tpunet/obs/flightrec/",
+                       "tpunet/elastic/agent.py")
 
 _REGISTRY_NAMES = {"register_thread", "THREADS"}
 

@@ -1,0 +1,200 @@
+"""R7 — cross-module donation taint (the R1 gap the re-mesh closed).
+
+R1's taint analysis stops at module edges: a call into another module
+is opaque, so it falls back to a *name* heuristic (``restore``/
+``load``-ish names taint) that both over-approximates (a function that
+re-materializes before returning is still flagged — hence the
+baseline ledger) and under-approximates (``grab_state()`` returning a
+``pickle.load`` escapes entirely). The elastic re-mesh made the gap
+load-bearing: the restore path now spans ``elastic/`` -> ``ckpt/`` ->
+``train/loop.py``, and the property that keeps it crash-free — the
+restored state is re-materialized (``jnp.copy``) BEFORE the trainer
+donates it — is a cross-module contract no single-file rule can see.
+
+R7 sees it. Two phases over the whole project:
+
+1. **summaries** (to a fixpoint): for every function/method, decide
+   whether its *return value* carries IO taint, using R1's own
+   analyzer over the body — ``pickle.load``-style origins taint,
+   ``jnp.copy``/``device_put``/``tree_map(jnp.copy, ...)`` clear, and
+   calls to already-summarized tainted functions propagate
+   (transitive). A function whose return is re-materialized gets a
+   CLEAN summary, exactly the precision R1's name heuristic lacks.
+2. **reporting**: re-run the call-site analysis with the summarized
+   tainted names as the ONLY taint sources. Names R1's heuristic
+   already matches are excluded from summaries on purpose: those
+   findings belong to R1 (and its baseline entries), so R7 never
+   duplicates them — it reports only what crossing the module
+   boundary revealed.
+
+Approximations (same spirit as R1's): resolution is by bare callee
+name, not import graph — two modules defining same-named functions
+share a summary (over-approximation, baseline-able); a summary is
+flow-insensitive over returns (ANY tainted return taints the
+function).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tpunet.analysis.core import (Finding, Project, Rule, SourceFile,
+                                  call_name)
+from tpunet.analysis.rules.donation import _IO_NAME_RE, _Analyzer
+
+
+class _SummaryAnalyzer(_Analyzer):
+    """R1's analyzer + 'calls to summarized-tainted names are IO'."""
+
+    def __init__(self, src: SourceFile, findings: List[Finding],
+                 flow_sensitive: bool, extra_io: Set[str]) -> None:
+        super().__init__(src, findings, flow_sensitive)
+        self.extra_io = extra_io
+
+    def is_io_call(self, node: ast.Call) -> bool:
+        name = call_name(node)
+        if name:
+            last = name.rsplit(".", 1)[-1]
+            if last in self.extra_io and not self.is_safe_wrapper(node):
+                return True
+        return super().is_io_call(node)
+
+
+class _ReportAnalyzer(_Analyzer):
+    """Call-site reporter whose ONLY taint sources are the summarized
+    cross-module names — R1-heuristic origins are invisible here, so
+    R7 findings never duplicate R1 findings."""
+
+    def __init__(self, src: SourceFile, findings: List[Finding],
+                 flow_sensitive: bool, extra_io: Set[str]) -> None:
+        super().__init__(src, findings, flow_sensitive)
+        self.extra_io = extra_io
+
+    def is_io_call(self, node: ast.Call) -> bool:
+        name = call_name(node)
+        if not name:
+            return False
+        last = name.rsplit(".", 1)[-1]
+        return last in self.extra_io and not self.is_safe_wrapper(node)
+
+
+def _return_exprs(fn: ast.AST) -> List[ast.AST]:
+    """Return expressions of ``fn``'s own body (nested function defs
+    return for themselves, not for ``fn``)."""
+    out: List[ast.AST] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Return) and child.value is not None:
+                out.append(child.value)
+            walk(child)
+
+    for stmt in getattr(fn, "body", []):
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            out.append(stmt.value)
+        walk(stmt)
+    return out
+
+
+def _function_defs(src: SourceFile
+                   ) -> List[Tuple[str, ast.AST, bool]]:
+    """(bare name, def node, is_method) for module-level functions and
+    class methods."""
+    out: List[Tuple[str, ast.AST, bool]] = []
+    assert isinstance(src.tree, ast.Module)
+    for stmt in src.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((stmt.name, stmt, False))
+        elif isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    out.append((sub.name, sub, True))
+    return out
+
+
+def _returns_tainted(src: SourceFile, fn: ast.AST,
+                     extra_io: Set[str]) -> bool:
+    """Does ``fn``'s return value carry IO taint? Runs the R1 machinery
+    over the body (populating local taint), then evaluates each return
+    expression against it."""
+    analyzer = _SummaryAnalyzer(src, [], flow_sensitive=True,
+                                extra_io=extra_io)
+    analyzer.scan_statements(getattr(fn, "body", []))
+    return any(analyzer._tainted_expr(expr) is not None
+               for expr in _return_exprs(fn))
+
+
+class CrossModuleDonationRule(Rule):
+    id = "R7"
+    name = "cross-module-donation"
+    doc = ("IO-tainted values returned by project functions (whose "
+           "names R1's heuristic misses) flowing into donated jit "
+           "args across module boundaries — the elastic re-mesh "
+           "restore-path contract")
+
+    MAX_FIXPOINT = 8
+
+    def run(self, project: Project) -> List[Finding]:
+        files = [src for src in project.files() if src.tree is not None]
+        # Phase 1: whole-project taint summaries, to a fixpoint so
+        # wrapper-of-wrapper chains (transitive) converge. Names the
+        # R1 heuristic already matches are R1's jurisdiction.
+        tainted_names: Set[str] = set()
+        defs: Dict[str, List[Tuple[SourceFile, ast.AST]]] = {}
+        for src in files:
+            for name, fn, _ in _function_defs(src):
+                defs.setdefault(name, []).append((src, fn))
+        for _ in range(self.MAX_FIXPOINT):
+            grew = False
+            for name, sites in defs.items():
+                if name in tainted_names \
+                        or _IO_NAME_RE.search(name):
+                    continue
+                # Conservative across same-name collisions: tainted if
+                # ANY definition's return is tainted.
+                if any(_returns_tainted(src, fn, tainted_names)
+                       for src, fn in sites):
+                    tainted_names.add(name)
+                    grew = True
+            if not grew:
+                break
+        if not tainted_names:
+            return []
+        # Phase 2: call-site reporting with ONLY the summarized names
+        # as taint sources (R1's own scope/class discipline reused).
+        findings: List[Finding] = []
+        for src in files:
+            assert isinstance(src.tree, ast.Module)
+            module_stmts: List[ast.stmt] = []
+            for stmt in src.tree.body:
+                if isinstance(stmt, ast.ClassDef):
+                    _ReportAnalyzer(src, findings, False,
+                                    tainted_names) \
+                        .scan_statements(stmt.body, passes=2)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    _ReportAnalyzer(src, findings, True,
+                                    tainted_names) \
+                        .scan_statements(stmt.body)
+                else:
+                    module_stmts.append(stmt)
+            _ReportAnalyzer(src, findings, True, tainted_names) \
+                .scan_statements(module_stmts)
+        return [Finding(
+            rule="R7", path=f.path, line=f.line,
+            message=f.message.replace(
+                "(the PR-7 resume heap-corruption class)",
+                "(cross-module: the producer lives in another "
+                "module and its return is IO-tainted — the PR-7 "
+                "resume heap-corruption class, invisible to "
+                "single-module R1)"),
+            hint=("re-materialize in the producer (return "
+                  "jnp.copy(...) / tree_map(jnp.copy, ...)) or at "
+                  "the call site before donating; a reviewed "
+                  "exception goes in docs/tpucheck_baseline.json"),
+            key=f"x{f.key}") for f in findings]

@@ -36,8 +36,9 @@ state is never persisted, so a crashed run restarts from scratch
 from __future__ import annotations
 
 import os
+import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +46,54 @@ import orbax.checkpoint as ocp
 
 from tpunet.config import CheckpointConfig
 from tpunet.obs import flightrec
+
+
+def emit_io_retry_alert(registry, *, what: str, error: str,
+                        max_retries: int, backoff_s: float) -> None:
+    """One loud ``obs_alert`` (reason ``ckpt_io_retry``) per retry
+    burst: the page says checkpoint IO went transiently bad BEFORE the
+    run either recovers silently or dies on the exhausted retry.
+    Module-level so the schema-conformance check can drive the exact
+    emission shape without a Checkpointer."""
+    if registry is None:
+        return
+    registry.counter("obs_alerts").inc()
+    registry.emit("obs_alert", {
+        "reason": "ckpt_io_retry", "step": 0, "severity": "warn",
+        "what": what, "error": error, "max_retries": max_retries,
+        "backoff_s": backoff_s,
+    })
+
+
+def _chaos():
+    """The installed fault injector (``--chaos``), or None. Looked up
+    lazily at each IO point so the Checkpointer costs nothing when
+    chaos is not armed and never imports the elastic package first."""
+    from tpunet.elastic import chaos
+    return chaos.current()
+
+
+def _multiprocessing_options() -> Optional["ocp.options.MultiprocessingOptions"]:
+    """Coordination-service barriers for multi-host orbax.
+
+    Orbax's default cross-host barrier is an XLA computation
+    (``sync_global_devices``) — run from our background writer thread
+    it interleaves with the step loop's own cross-process
+    computations and aborts the transport (observed on CPU gangs as
+    gloo's "op.preamble.length <= op.nbytes" hard abort mid-save;
+    the same enqueue-order hazard exists on any backend). With
+    ``active_processes`` set, orbax switches every barrier to the
+    jax coordination-service KV barrier, which its own docs mark
+    "safe to use from independent background threads" — exactly our
+    writer-thread situation. None single-process or when no
+    coordination client exists (then no barriers run at all)."""
+    if jax.process_count() <= 1:
+        return None
+    from tpunet.parallel.dist import coordination_client
+    if coordination_client() is None:
+        return None
+    return ocp.options.MultiprocessingOptions(
+        active_processes=set(range(jax.process_count())))
 
 
 def _snapshot(tree):
@@ -56,18 +105,64 @@ def _snapshot(tree):
 
 
 class Checkpointer:
+    # Transient-IO discipline: a save/restore OSError is retried this
+    # many times with exponential backoff (base IO_BACKOFF_S, doubling
+    # per attempt) before propagating. One obs_alert per burst
+    # (reason ckpt_io_retry) + the ckpt_io_retries counter make the
+    # flakiness visible even when every retry succeeds.
+    IO_RETRIES = 3
+    IO_BACKOFF_S = 0.1
+
     def __init__(self, cfg: CheckpointConfig, obs=None):
         self.cfg = cfg
         self.directory = os.path.abspath(os.path.expanduser(cfg.directory))
         self._mgr: Optional[ocp.CheckpointManager] = None
-        self._best = ocp.StandardCheckpointer()
+        self._mp_options = _multiprocessing_options()
+        self._best = (ocp.StandardCheckpointer()
+                      if self._mp_options is None
+                      else ocp.StandardCheckpointer(
+                          multiprocessing_options=self._mp_options))
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pending = []
+        # 1-based dispatch ordinals: the chaos injector addresses "the
+        # K-th save" / "the K-th restore" deterministically.
+        self._save_index = 0
+        self._restore_index = 0
+        # Escalated-preemption escape hatch: once abandoned, nothing
+        # here blocks again (wait/close become no-ops) — the process
+        # is exiting inside a nearly-spent grace window.
+        self._abandoned = False
         # Optional Observability (tpunet/obs/): labels save dispatch
         # and durability waits as xprof spans and accounts their host
         # cost (ckpt_saves / ckpt_wait_s) — the "is the step loop
         # stalling on checkpoints?" half of the stall split.
         self._obs = obs
+
+    def _with_io_retry(self, what: str, fn: Callable[[int], Any]) -> Any:
+        """Run ``fn(attempt)`` with bounded retry + exponential backoff
+        on OSError (the transient-IO shape: NFS blips, GCS 5xx surfaced
+        as IOError, chaos injection). Non-OSErrors propagate untouched
+        — a corrupt checkpoint is not transient."""
+        attempt = 0
+        while True:
+            try:
+                return fn(attempt)
+            except OSError as e:
+                attempt += 1
+                if attempt > self.IO_RETRIES:
+                    raise
+                if attempt == 1:
+                    emit_io_retry_alert(
+                        self._obs.registry if self._obs is not None
+                        else None,
+                        what=what, error=str(e),
+                        max_retries=self.IO_RETRIES,
+                        backoff_s=self.IO_BACKOFF_S)
+                if self._obs is not None:
+                    self._obs.registry.counter("ckpt_io_retries").inc()
+                flightrec.record(
+                    "ckpt", f"io retry {what} attempt={attempt}")
+                time.sleep(self.IO_BACKOFF_S * (2 ** (attempt - 1)))
 
     def _span(self, name: str):
         if self._obs is not None:
@@ -78,14 +173,24 @@ class Checkpointer:
     @property
     def manager(self) -> ocp.CheckpointManager:
         if self._mgr is None:
+            state_dir = os.path.join(self.directory, "state")
+            kw = {}
+            create = True
+            if self._mp_options is not None:
+                # KV barriers (see _multiprocessing_options). Orbax
+                # refuses create=True with active_processes, so make
+                # the directory ourselves (shared fs, idempotent).
+                kw["multiprocessing_options"] = self._mp_options
+                create = False
+                os.makedirs(state_dir, exist_ok=True)
             self._mgr = ocp.CheckpointManager(
-                os.path.join(self.directory, "state"),
+                state_dir,
                 options=ocp.CheckpointManagerOptions(
-                    max_to_keep=self.cfg.keep, create=True,
+                    max_to_keep=self.cfg.keep, create=create,
                     # Explicit, not default-dependent: even with the
                     # worker thread owning the blocking phase, the
                     # write itself should overlap manager bookkeeping.
-                    enable_async_checkpointing=True),
+                    enable_async_checkpointing=True, **kw),
             )
         return self._mgr
 
@@ -160,6 +265,8 @@ class Checkpointer:
             snap = _snapshot(payload)
         if self._obs is not None:
             self._obs.registry.counter("ckpt_saves").inc()
+        self._save_index += 1
+        save_index = self._save_index
         # The manager is created INSIDE the worker lambda on purpose:
         # CheckpointManager.__init__ runs a cross-host barrier
         # (sync_global_processes), so on multi-host it must stay
@@ -173,8 +280,22 @@ class Checkpointer:
         # construction) is covered by its pending-futures check: the
         # submitted future is not done while the manager is being
         # built.
-        self._submit(lambda: self.manager.save(
-            step, args=ocp.args.StandardSave(snap)))
+        def write(attempt: int) -> None:
+            chaos = _chaos()
+            if chaos is not None:
+                # May raise the injected transient OSError (exercised
+                # by the retry wrapper below) — addressed by dispatch
+                # ordinal + attempt, so the scenario is deterministic.
+                chaos.save_attempt(save_index, attempt)
+            self.manager.save(step, args=ocp.args.StandardSave(snap))
+            if chaos is not None:
+                # Mid-checkpoint-write kill point: the orbax write is
+                # dispatched but not yet finalized — dying here leaves
+                # a torn, uncommitted step directory that restore MUST
+                # skip in favor of the previous intact checkpoint.
+                chaos.save_in_flight(save_index)
+
+        self._submit(lambda: self._with_io_retry("save", write))
 
     def latest_step(self) -> Optional[int]:
         self._drain()
@@ -220,8 +341,17 @@ class Checkpointer:
             logging.getLogger(__name__).warning(
                 "checkpoint metadata probe failed (restoring with the "
                 "full target): %s", e)
-        restored = self.manager.restore(
-            step, args=ocp.args.StandardRestore(target))
+        self._restore_index += 1
+        restore_index = self._restore_index
+
+        def read(attempt: int):
+            chaos = _chaos()
+            if chaos is not None:
+                chaos.restore_attempt(restore_index, attempt)
+            return self.manager.restore(
+                step, args=ocp.args.StandardRestore(target))
+
+        restored = self._with_io_retry("restore", read)
         # Re-materialize every restored array as an XLA-owned copy
         # (one transient duplicate, freed immediately). ROOT CAUSE of
         # the long-open resume heap corruption (ROADMAP bug, flight-
@@ -280,7 +410,7 @@ class Checkpointer:
                     json.dump(meta, f, indent=1)
                 os.replace(tmp, meta_path)
                 wrote_sidecar = True
-            try:
+            def write_best(attempt: int) -> None:
                 self._best.save(path, snap, force=True)
                 # StandardCheckpointer is an AsyncCheckpointer: the
                 # write/commit runs on orbax's own background thread
@@ -289,8 +419,12 @@ class Checkpointer:
                 # try — we already run on the dedicated worker thread,
                 # so blocking costs the step loop nothing, and an
                 # async-phase failure (disk full mid-write) now rolls
-                # the sidecar back like a synchronous one.
+                # the sidecar back like a synchronous one (or is
+                # retried as a transient by the wrapper).
                 self._best.wait_until_finished()
+
+            try:
+                self._with_io_retry("save_best", write_best)
             except BaseException:
                 # Roll the sidecar back: a failed best-save must not
                 # leave a NEW sidecar durably paired with the OLD
@@ -327,22 +461,100 @@ class Checkpointer:
             return None
         return self._best.restore(path, target=target)
 
-    def wait(self) -> None:
-        """Block until async writes are durable (end of run)."""
-        import time
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until async writes are durable (end of run). With a
+        ``timeout`` (the preemption path's remaining grace budget),
+        the wait is bounded: on expiry it logs loudly and returns
+        False — the checkpoint may not be durable, but blowing the
+        platform's grace window guarantees a SIGKILL mid-write, which
+        is strictly worse. Returns True when everything committed."""
+        if self._abandoned:
+            return False
         t0 = time.perf_counter()
         flightrec.record("ckpt", "wait begin")
         with self._span("tpunet/ckpt_wait"):
-            self._drain()
-            if self._mgr is not None:
-                self._mgr.wait_until_finished()
-            self._best.wait_until_finished()
+            if timeout is None:
+                self._drain()
+                if self._mgr is not None:
+                    self._mgr.wait_until_finished()
+                self._best.wait_until_finished()
+                durable = True
+            else:
+                durable = self._bounded_drain(timeout)
         flightrec.record("ckpt", "wait end")
         if self._obs is not None:
             self._obs.registry.counter("ckpt_wait_s").inc(
                 time.perf_counter() - t0)
+        return durable
+
+    def _bounded_drain(self, timeout: float) -> bool:
+        import threading
+        from concurrent.futures import TimeoutError as FutTimeout
+        deadline = time.perf_counter() + max(0.0, timeout)
+        pending = list(self._pending)
+        for f in pending:
+            budget = deadline - time.perf_counter()
+            try:
+                f.result(timeout=max(0.0, budget))
+            except FutTimeout:
+                # The budget is spent: give up for good (abandon —
+                # this and every later future's result is forfeit by
+                # design, the process is exiting). Any later blocking
+                # wait (close() in main's finally) would hold the
+                # process past the platform's SIGKILL — strictly
+                # worse than resuming from the previous intact
+                # checkpoint.
+                return self._grace_expired(timeout)
+        self._pending = []
+        # The orbax managers expose no timed wait — bound their
+        # commit join with a side thread so a slow async finalize
+        # cannot blow the window either.
+        finished = threading.Event()
+
+        def _orbax_join() -> None:
+            try:
+                if self._mgr is not None:
+                    self._mgr.wait_until_finished()
+                self._best.wait_until_finished()
+            finally:
+                finished.set()
+
+        threading.Thread(target=_orbax_join,
+                         name="tpunet-ckpt-grace-join",
+                         daemon=True).start()
+        if finished.wait(timeout=max(0.0,
+                                     deadline - time.perf_counter())):
+            return True
+        return self._grace_expired(timeout)
+
+    def _grace_expired(self, timeout: float) -> bool:
+        """The grace budget ran out mid-drain: warn loudly and go
+        permanently non-blocking (abandon) so no later wait/close can
+        stall the exiting process."""
+        print("WARNING: checkpoint durability wait exceeded the "
+              f"{timeout:.1f}s grace budget — the last save may not "
+              "be committed; resume will fall back to the previous "
+              "intact checkpoint", flush=True)
+        self.abandon()
+        return False
+
+    def abandon(self) -> None:
+        """Escalated preemption: stop blocking on checkpoint work,
+        permanently. Queued saves are dropped (their futures may still
+        run on daemon-irrelevant worker threads, but nothing joins
+        them) and every later ``wait``/``close`` is a no-op — the
+        caller is exiting NOW and resume falls back to the last
+        committed checkpoint."""
+        flightrec.record("ckpt", "abandon")
+        self._abandoned = True
+        self._pending = []
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
     def close(self) -> None:
+        if self._abandoned:
+            return
         self.wait()
         if self._pool is not None:
             self._pool.shutdown(wait=True)

@@ -371,6 +371,13 @@ class ObsConfig:
     # "NAME < N", or "NAME + N/s" (growth rate). Fired rules emit
     # gauge_predicate obs_alerts (--obs-rule, repeatable).
     gauge_rules: Tuple[str, ...] = ()
+    # Proactive checkpoint-and-evict (--evict-on-straggler,
+    # docs/elasticity.md): a straggler-shaped watchdog alert on THIS
+    # replica (step_stall / thread_stalled) triggers the agreed stop
+    # with an evict marker — the pod checkpoints now and re-meshes
+    # without the slow host instead of letting it stall every step.
+    # Off by default; meaningful under the elastic agent.
+    evict_on_straggler: bool = False
     # -- flight recorder (tpunet/obs/flightrec/) --------------------
     # Always-on black box: a crash-durable mmap ring of recent
     # structured events, faulthandler + native SIGSEGV/SIGABRT/SIGBUS
@@ -444,6 +451,16 @@ class TrainConfig:
 
     epochs: int = 20                  # reference EPOCHS (:158)
     seed: int = 42                    # reference torch.manual_seed(42) (:58)
+    # Fault injection (--chaos, tpunet/elastic/chaos.py): deterministic
+    # SIGKILL/SIGTERM/slow-host/checkpoint-IO faults addressed by step
+    # or save ordinal — docs/elasticity.md "Chaos spec grammar". Empty
+    # = no injector installed.
+    chaos: str = ""
+    # Preemption grace window (--preempt-grace-s): seconds the platform
+    # grants after SIGTERM. The guard budgets the checkpoint-durability
+    # wait inside it and a second SIGTERM escalates to an immediate
+    # checkpoint-abandon exit. 0 = unknown/unbounded (legacy behavior).
+    preempt_grace_s: float = 0.0
     # Evaluate a saved checkpoint (best params if present, else the
     # last full state) and exit — no training.
     eval_only: bool = False
@@ -701,6 +718,25 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="suppress same-reason obs_alerts within this "
                         "many steps (counted in obs_alerts_suppressed) "
                         "so a stall pages once")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="deterministic fault injection "
+                        "(docs/elasticity.md): e.g. 'kill@step=5', "
+                        "'kill@ckpt=2', 'sigterm@step=8:again=1', "
+                        "'slow@step=10:delay=1:steps=3', "
+                        "'ioerr@save=1:fails=2'; ';'-separated, "
+                        "host=H scopes one process")
+    p.add_argument("--preempt-grace-s", type=float, default=None,
+                   help="SIGTERM grace window the platform grants: "
+                        "the preemption save's durability wait is "
+                        "bounded by what remains of it, and a second "
+                        "SIGTERM escalates to immediate "
+                        "checkpoint-abandon exit (0 = unbounded)")
+    p.add_argument("--evict-on-straggler", action="store_true",
+                   help="straggler-shaped watchdog alerts (step_stall"
+                        "/thread_stalled) on this replica trigger "
+                        "checkpoint-now-then-evict through the agreed "
+                        "stop — the elastic agent re-meshes the pod "
+                        "without the slow host (docs/elasticity.md)")
     p.add_argument("--halt-on-unhealthy", action="store_true",
                    help="abort the run (RunUnhealthyError) on a fatal "
                         "obs_alert: step stall, NaN/spiking loss, or "
@@ -809,6 +845,8 @@ def config_from_args(argv=None) -> TrainConfig:
         obs = dataclasses.replace(obs, export=export)
     if args.halt_on_unhealthy:
         obs = dataclasses.replace(obs, halt_on_unhealthy=True)
+    if args.evict_on_straggler:
+        obs = dataclasses.replace(obs, evict_on_straggler=True)
     if args.run_id is not None:
         obs = dataclasses.replace(obs, run_id=args.run_id)
     if args.obs_rule:
@@ -937,6 +975,10 @@ def config_from_args(argv=None) -> TrainConfig:
         cfg = cfg.replace(epochs=args.epochs)
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
+    if args.chaos is not None:
+        cfg = cfg.replace(chaos=args.chaos)
+    if args.preempt_grace_s is not None:
+        cfg = cfg.replace(preempt_grace_s=args.preempt_grace_s)
     if args.profile_dir is not None:
         cfg = cfg.replace(profile_dir=args.profile_dir)
     if args.log_every_steps is not None:

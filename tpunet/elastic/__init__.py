@@ -1,0 +1,45 @@
+"""Elastic grow/shrink training: chaos, rendezvous, and the agent.
+
+The paper's MPI/DDP world has no failure story: one lost rank kills
+the whole job and the only recovery is an epoch-0 restart (SURVEY.md
+section 5). tpunet already had every piece of a better story built
+separately — preemption agreement in the trainer, multi-controller
+checkpoint roundtrip, step-aligned straggler alerts, crash forensics
+that survive SIGKILL, the run-history store that makes a restarted
+run judgeable — yet a lost host still ended the run. This package
+wires them into one closed loop:
+
+- ``chaos``      — deterministic fault injection (``--chaos`` on the
+  train CLI): SIGKILL mid-step and mid-checkpoint-write, SIGTERM
+  preemption with escalation, slow-host delay, transient checkpoint
+  IO errors. Seeded and step-addressed, so every failure scenario is
+  a reproducible test, not a war story (docs/elasticity.md grammar).
+- ``rendezvous`` — filesystem rendezvous for surviving hosts:
+  generation-numbered, epoch/step-stamped announcements, timeout-
+  bounded gather with a clean "cannot form quorum" degradation path,
+  departure markers and join requests (grow).
+- ``agent``      — the per-host supervisor (``python -m
+  tpunet.elastic``): launches the trainer as a child process, detects
+  child death / peer loss / preemption stops, re-rendezvous with the
+  survivors, and relaunches the child against the resized world with
+  ``--resume`` — the mesh's data axis follows the world, FSDP state
+  re-shards onto the new mesh at restore, and the run keeps its
+  ``run_id`` so the metrics stream (and the PR-9 history store)
+  continues across generations.
+- ``events``     — the ``obs_elastic`` record kind (shrink / grow /
+  restart / evict / quorum_failed / remesh / recovered) appended into
+  the run's ``metrics.jsonl`` and routed through the fleet dashboard
+  and the alert webhook (docs/metrics_schema.md).
+"""
+
+from __future__ import annotations
+
+from tpunet.elastic.chaos import Chaos, ChaosSpecError
+from tpunet.elastic.events import (ELASTIC_KIND, append_elastic_record,
+                                   build_elastic_record)
+from tpunet.elastic.rendezvous import QuorumError, Rendezvous
+
+__all__ = [
+    "Chaos", "ChaosSpecError", "ELASTIC_KIND", "QuorumError",
+    "Rendezvous", "append_elastic_record", "build_elastic_record",
+]

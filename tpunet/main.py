@@ -44,13 +44,21 @@ def main(argv=None) -> int:
          f"({jax.local_device_count()} local), processes: {n_proc}")
 
     # Dataset fetch gate (reference rank-0 download + barrier, :93-102):
-    # process 0 touches the data dir first, other hosts wait.
-    if jax.process_index() == 0:
-        trainer = Trainer(cfg)
+    # process 0 materializes the data first, other hosts wait — and
+    # ONLY the dataset. Trainer construction issues device-layout
+    # computations (the sharded state device_put / jit-identity), and
+    # cross-process collectives must run in the SAME order on every
+    # process (gloo on CPU gangs pairs them strictly by sequence; the
+    # old p0-builds-Trainer-before-the-barrier shape interleaved p0's
+    # layout computations with the others' barrier psum and died in
+    # gloo's preamble check) — so construction is symmetric, after the
+    # barrier.
+    if n_proc > 1:
+        if jax.process_index() == 0:
+            from tpunet.data import get_dataset
+            get_dataset(cfg.data)
         sync_hosts("dataset-ready")
-    else:
-        sync_hosts("dataset-ready")
-        trainer = Trainer(cfg)
+    trainer = Trainer(cfg)
 
     try:
         if cfg.eval_only:

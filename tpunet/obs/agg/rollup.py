@@ -52,6 +52,12 @@ class StreamState:
         # Serving-side digest.
         self.last_serve: Optional[dict] = None
         self.serve_records = 0
+        # Elasticity digest (tpunet/elastic/): membership changes are
+        # part of the stream's judgeable history — a shrink explains a
+        # throughput step-change the regression panel would otherwise
+        # flag blind.
+        self.elastic_events = 0
+        self.last_elastic: Optional[dict] = None
 
     # -- ingest ----------------------------------------------------------
 
@@ -97,6 +103,9 @@ class StreamState:
             # fleet view can say which replica is crash-looping.
             self.crashes += 1
             self.last_crash = record
+        elif kind == "obs_elastic":
+            self.elastic_events += 1
+            self.last_elastic = record
 
     # -- derived ---------------------------------------------------------
 
@@ -178,6 +187,19 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
     crashes = sum(s.crashes for s in streams)
     if crashes:
         out["crashes_total"] = crashes
+    elastic = sum(s.elastic_events for s in streams)
+    if elastic:
+        out["elastic_events_total"] = elastic
+        # The most recent membership change across streams: the
+        # dashboard head-line ("shrink 2->1, gen 3") without digging
+        # per stream.
+        last = max((s.last_elastic for s in streams
+                    if s.last_elastic is not None),
+                   key=lambda r: r.get("time", 0) or 0, default=None)
+        if last is not None:
+            out["elastic_last_event"] = str(last.get("event", ""))
+            if last.get("generation") is not None:
+                out["elastic_generation"] = last["generation"]
     per_stream: List[dict] = []
 
     # -- training rollup -------------------------------------------------
@@ -279,6 +301,11 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
                      "alerts": s.alerts}
         if s.crashes:
             row["crashes"] = s.crashes
+        if s.elastic_events:
+            row["elastic_events"] = s.elastic_events
+            if s.last_elastic is not None:
+                row["elastic_last_event"] = str(
+                    s.last_elastic.get("event", ""))
         row.update(s.identity)
         if s.last_epoch is not None:
             row["epoch"] = s.last_epoch.get("epoch")

@@ -40,8 +40,11 @@ from typing import Optional, Tuple
 
 #: Record kinds that page. Everything else is dropped at write() for
 #: the cost of one dict lookup — the "configured but idle" overhead
-#: the obs budget gate measures.
-ALERT_KINDS = ("obs_alert", "obs_crash", "obs_regression")
+#: the obs budget gate measures. obs_elastic pages because a
+#: membership change is operator-actionable (a shrink is capacity
+#: loss; a quorum failure is an outage).
+ALERT_KINDS = ("obs_alert", "obs_crash", "obs_regression",
+               "obs_elastic")
 
 _CLOSE = object()
 
@@ -60,6 +63,18 @@ def _summary_line(record: dict) -> str:
         return (f"tpunet regression{where}: {n} metric(s) regressed "
                 f"comparing {record.get('run_b', '?')} against "
                 f"{record.get('run_a', '?')}")
+    if kind == "obs_elastic":
+        event = record.get("event", "elastic")
+        worlds = ""
+        if record.get("old_world") is not None \
+                or record.get("new_world") is not None:
+            worlds = (f" world {record.get('old_world', '?')}->"
+                      f"{record.get('new_world', '?')}")
+        gen = record.get("generation")
+        gen_s = f" gen {gen}" if gen is not None else ""
+        cause = record.get("cause")
+        cause_s = f" ({cause})" if cause else ""
+        return f"tpunet elastic {event}{where}:{worlds}{gen_s}{cause_s}"
     reason = record.get("reason", "alert")
     sev = record.get("severity", "warn")
     return f"tpunet {reason} [{sev}]{where} at step {record.get('step', 0)}"
@@ -74,7 +89,8 @@ def build_payload(record: dict, source: str = "tpunet") -> dict:
         "kind": record.get("kind", "obs_alert"),
         "reason": record.get("reason",
                              "crash" if record.get("kind") == "obs_crash"
-                             else record.get("verdict", "alert")),
+                             else record.get("event")
+                             or record.get("verdict", "alert")),
         "severity": record.get("severity", "warn"),
         "summary": _summary_line(record),
         "detail": record,

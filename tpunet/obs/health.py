@@ -195,6 +195,14 @@ class Watchdog:
         # halts every process at a step boundary. When unset
         # (single-process), a fatal alert raises directly.
         self.on_fatal = None
+        # Proactive checkpoint-and-evict hook (--evict-on-straggler,
+        # docs/elasticity.md): the trainer sets this; straggler-shaped
+        # alerts (step_stall / thread_stalled) on THIS replica then
+        # trigger a checkpoint-now-then-evict through the agreed stop
+        # instead of letting the slow host stall the whole pod. Called
+        # AFTER the alert record is emitted, subject to the same
+        # cooldown as the page itself.
+        self.on_evict = None
         self._clock = clock
         self._laps: deque = deque(maxlen=self.WINDOW)
         self._loss_ema: Optional[float] = None
@@ -394,6 +402,12 @@ class Watchdog:
         record.update(detail)
         self.alerts.append(record)
         self.registry.emit("obs_alert", record)
+        if (self.on_evict is not None
+                and reason in ("step_stall", "thread_stalled")):
+            # Straggler shape on this replica: hand the record to the
+            # trainer's evict path (record-first ordering preserved —
+            # the page explains the evict that follows).
+            self.on_evict(record)
         if self.cfg.halt_on_unhealthy and fatal:
             if self.on_fatal is not None:
                 self.on_fatal(record)

@@ -52,20 +52,35 @@ def sample_memory_gauges(registry) -> List[Dict]:
     return records
 
 
+_HEARTBEAT_SEQ = 0
+
+
 def heartbeat(registry, elapsed_s: float) -> int:
-    """Coordinator-side liveness gauge: every process contributes a
-    flag to an allgather (so a wedged host surfaces as a hang HERE, at
-    a labeled epoch boundary, rather than deep inside a step's
-    collective); the coordinator records how many answered and when.
-    Single-process runs skip the collective."""
+    """Coordinator-side liveness gauge: every process checks in at the
+    epoch boundary; the coordinator records how many answered and
+    when. Routed through the coordination-service KV store
+    (tpunet/parallel/dist.kv_live_processes) because the epoch
+    boundary is exactly where the async checkpoint worker is running
+    orbax's cross-host barriers — an allgather here from the main
+    thread interleaves with them and aborts the transport (same bug
+    class as the stop agreement; see Trainer._agree_stop). Allgather
+    remains the fallback when no coordination service exists; the
+    sequence counter advances identically on every process (one call
+    per epoch boundary each)."""
+    global _HEARTBEAT_SEQ
     n = jax.process_count()
     if n > 1:
-        import jax.numpy as jnp
-        import numpy as np
-        from jax.experimental import multihost_utils
-        flags = multihost_utils.process_allgather(
-            jnp.ones((), jnp.int32))
-        n = int(np.asarray(flags).sum())
+        from tpunet.parallel.dist import kv_live_processes
+        _HEARTBEAT_SEQ += 1
+        live = kv_live_processes(f"epoch/{_HEARTBEAT_SEQ}")
+        if live is None:
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.experimental import multihost_utils
+            flags = multihost_utils.process_allgather(
+                jnp.ones((), jnp.int32))
+            live = int(np.asarray(flags).sum())
+        n = live
     registry.gauge("live_processes").set(n)
     registry.gauge("heartbeat_s").set(elapsed_s)
     return n

@@ -24,7 +24,7 @@ import jax
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[str] = None) -> None:
+                           process_id: Optional[int] = None) -> None:
     """Initialize multi-controller JAX when running as part of a pod/cluster.
 
     Safe to call unconditionally: a no-op for single-process runs unless
@@ -34,6 +34,15 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     configured = (coordinator_address or num_processes
                   or env.get("JAX_COORDINATOR_ADDRESS")
                   or env.get("JAX_NUM_PROCESSES"))
+    # jax only resolves JAX_COORDINATOR_ADDRESS itself (0.4.x);
+    # num_processes/process_id would fall through to cluster
+    # auto-detection and fail on a plain CPU gang — resolve the env
+    # vars here so the elastic agent's injected world (and the
+    # docstring's claim) actually works.
+    if num_processes is None and env.get("JAX_NUM_PROCESSES"):
+        num_processes = int(env["JAX_NUM_PROCESSES"])
+    if process_id is None and env.get("JAX_PROCESS_ID"):
+        process_id = int(env["JAX_PROCESS_ID"])
     # Multi-host TPU pod: TPU_WORKER_HOSTNAMES lists >1 worker. (A
     # single-host TPU VM also sets the variable; initialize() is neither
     # needed nor safe there if the backend was already touched.)
@@ -42,6 +51,18 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                   or env.get("MEGASCALE_COORDINATOR_ADDRESS"))
     if not (configured or on_tpu_pod):
         return
+    if env.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        # CPU gangs (tests, the elastic agent's CPU worlds): jax's
+        # cross-process collectives need an explicit implementation —
+        # the flag's env var is not consulted at backend init on this
+        # jax, so without this every cross-process psum dies with
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend". gloo ships inside jaxlib; harmless single-process.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass  # older/newer jax without the flag: keep going
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -64,3 +85,79 @@ def sync_hosts(name: str = "barrier") -> None:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
+
+
+# -- collective-free host agreements -----------------------------------
+#
+# ``process_allgather`` is an XLA computation: when the MAIN thread
+# runs one while the async checkpoint WORKER thread is inside one of
+# orbax's cross-host barriers (sync_global_devices), the two
+# processes' collective sequences interleave differently and the
+# transport aborts (observed on CPU gangs as gloo's
+# "op.preamble.length <= op.nbytes" hard abort mid-save). Host-side
+# agreements that can overlap async checkpointing therefore go
+# through the jax coordination-service KV store instead — plain gRPC
+# to the coordinator, no XLA, safe from any thread.
+
+
+def coordination_client():
+    """The jax coordination-service client, or None (single process /
+    distributed not initialized)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+
+
+_AGREE_TIMEOUT_MS = 300_000
+
+
+def agree_any(tag: str, flag: bool, *,
+              timeout_ms: int = _AGREE_TIMEOUT_MS) -> Optional[bool]:
+    """Cross-process OR of a host-side flag (the preemption/evict stop
+    agreement) without XLA collectives. ``tag`` must be unique per
+    agreement round and identical across processes (e.g. the global
+    step). Returns None when no coordination client exists — the
+    caller falls back to ``process_allgather`` (which is then safe:
+    no coordination service means no multi-controller orbax either).
+    """
+    client = coordination_client()
+    if client is None:
+        return None
+    base = f"tpunet_agree/{tag}"
+    # allow_overwrite: re-agreement on a reused tag (a second trainer
+    # incarnation in one process) must be idempotent, not a KV error.
+    client.key_value_set(f"{base}/{jax.process_index()}",
+                         "1" if flag else "0", allow_overwrite=True)
+    client.wait_at_barrier(f"{base}/barrier", timeout_ms)
+    return any(
+        client.blocking_key_value_get(f"{base}/{i}", timeout_ms) == "1"
+        for i in range(jax.process_count()))
+
+
+def kv_live_processes(tag: str, *,
+                      timeout_ms: int = _AGREE_TIMEOUT_MS
+                      ) -> Optional[int]:
+    """Epoch-heartbeat liveness via the KV store: how many processes
+    checked in for this ``tag``. A dead peer surfaces as a barrier
+    error -> count whoever did check in (bounded short gets) instead
+    of hanging in a device collective. None without a client."""
+    client = coordination_client()
+    if client is None:
+        return None
+    base = f"tpunet_hb/{tag}"
+    client.key_value_set(f"{base}/{jax.process_index()}", "1",
+                         allow_overwrite=True)
+    try:
+        client.wait_at_barrier(f"{base}/barrier", timeout_ms)
+        return jax.process_count()
+    except Exception:
+        live = 0
+        for i in range(jax.process_count()):
+            try:
+                client.blocking_key_value_get(f"{base}/{i}", 1000)
+                live += 1
+            except Exception:
+                continue
+        return live

@@ -47,6 +47,36 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
     return Mesh(dmesh, ("data", "seq", "pipe", "model"))
 
 
+def mesh_shape_dict(mesh: Mesh) -> dict:
+    """Axis sizes as a plain dict (``{"data": 2, "seq": 1, ...}``) —
+    the ``obs_elastic`` record shape for old/new mesh on grow/shrink
+    (docs/metrics_schema.md), and generally the JSON-able mesh
+    identity."""
+    return {name: int(size) for name, size in mesh.shape.items()}
+
+
+def elastic_data_axis(cfg: Optional[MeshConfig], n_devices: int) -> int:
+    """The data-axis size a (re)formed world of ``n_devices`` yields.
+
+    Elastic grow/shrink resizes ONLY the data axis: seq/pipe/model are
+    workload topology (sharded math) while data is throughput — a
+    surviving pod keeps the model partitioning and spreads the batch
+    over fewer replicas. Raises when the fixed axes no longer fit the
+    surviving devices (the agent surfaces this as a quorum-style
+    degradation instead of letting jit fail deep in the restore)."""
+    cfg = cfg or MeshConfig()
+    seq = max(1, cfg.seq)
+    pipe = max(1, cfg.pipe)
+    model = max(1, cfg.model)
+    fixed = seq * pipe * model
+    if n_devices < fixed:
+        raise ValueError(
+            f"surviving world has {n_devices} device(s) but the mesh "
+            f"needs seq*pipe*model = {fixed}; the pod cannot shrink "
+            "below its model-parallel footprint")
+    return max(1, n_devices // fixed)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Batch-dim sharding over the data axis (DistributedSampler analog)."""
     return NamedSharding(mesh, P(("data",)))

@@ -200,3 +200,17 @@ def tree_shardings(tree, mesh: Mesh, rules: Rules):
         lambda p, x: NamedSharding(mesh, _spec_for(_path_str(p), x, mesh,
                                                    compiled)),
         tree)
+
+
+def state_shardings(state, model_cfg: ModelConfig, mesh: Mesh, *,
+                    zero1: bool = False, fsdp: bool = False):
+    """Shardings for laying a train state out on ``mesh`` — THE layout
+    the Trainer pins as its steps' in/out shardings, factored here so
+    the elastic restore path targets the identical function: restoring
+    an FSDP checkpoint onto a resized mesh is ``restore_state`` with a
+    target built by this on the NEW mesh, and every leaf (params, both
+    Adam moments, EMA mirrors) re-shards to the new data axis because
+    ``_fsdp_spec`` re-resolves per leaf against the new axis size."""
+    return tree_shardings(
+        state, mesh, rules_for(model_cfg, mesh=mesh, zero1=zero1,
+                               fsdp=fsdp))

@@ -25,9 +25,11 @@ from tpunet.data import (eval_batches, get_dataset, steps_per_epoch,
 from tpunet.obs import JsonlSink, Observability, RunUnhealthyError
 from tpunet.obs import flightrec
 from tpunet.obs.perf import train_flops_per_unit
+from tpunet.elastic import events as elastic_events
 from tpunet.parallel import (batch_sharding, make_mesh, replicated_sharding,
                              shard_host_batch)
-from tpunet.parallel.tp import rules_for, tree_shardings
+from tpunet.parallel.mesh import mesh_shape_dict
+from tpunet.parallel.tp import rules_for, state_shardings, tree_shardings
 from tpunet.train import metrics as M
 from tpunet.train.state import create_train_state, lr_schedule
 from tpunet.train.steps import (make_eval_step, make_lm_eval_step,
@@ -73,10 +75,13 @@ class Trainer:
         # Adam moments) matching the model's TP path rules are sharded
         # over the 'model' mesh axis; everything else is replicated, which
         # is exactly the reference's DDP layout (README:77).
-        state_sh = tree_shardings(
-            state, self.mesh,
-            rules_for(cfg.model, mesh=self.mesh, zero1=cfg.mesh.zero1,
-                      fsdp=cfg.mesh.fsdp))
+        # state_shardings is also the elastic re-mesh contract: a
+        # resized world builds this against ITS mesh and the restore
+        # re-shards every FSDP leaf onto the new data axis
+        # (docs/elasticity.md).
+        state_sh = state_shardings(
+            state, cfg.model, self.mesh, zero1=cfg.mesh.zero1,
+            fsdp=cfg.mesh.fsdp)
         if jax.process_count() > 1:
             try:
                 self.state = jax.device_put(state, state_sh)
@@ -236,8 +241,61 @@ class Trainer:
         self.obs.set_flops_per_unit(train_flops_per_unit(
             cfg.model, cfg.data, n_params=num_params(state.params)))
         self.ckpt = Checkpointer(cfg.checkpoint, obs=self.obs)
-        self.guard = PreemptionGuard()
+        self.guard = PreemptionGuard(deadline_s=cfg.preempt_grace_s)
+        # Fault injection (--chaos): armed process-globally so the
+        # checkpointer's IO hooks reach the same injector; scoped to
+        # this process index (host=H events address one gang member).
+        self._chaos = None
+        if cfg.chaos:
+            from tpunet.elastic import chaos as chaos_mod
+            self._chaos = chaos_mod.install(
+                cfg.chaos, process_index=jax.process_index())
+        # Elastic-agent context (TPUNET_ELASTIC_* env): generation
+        # gauges for the fleet view, the previous incarnation's mesh
+        # for the "recovered" record, and this incarnation's mesh
+        # persisted for the NEXT one.
+        self._elastic = elastic_events.agent_env()
+        self._prev_mesh = None
+        if self._elastic is not None:
+            run_dir = cfg.checkpoint.directory
+            self._prev_mesh = elastic_events.read_mesh(run_dir)
+            if jax.process_index() == 0:
+                elastic_events.write_mesh(run_dir,
+                                          mesh_shape_dict(self.mesh))
+            if self.obs.enabled:
+                reg = self.obs.registry
+                reg.gauge("elastic_generation").set(
+                    self._elastic["generation"])
+                reg.gauge("elastic_world_processes").set(
+                    jax.process_count())
         self._watchdog_halt = None
+        # Proactive checkpoint-and-evict (--evict-on-straggler): a
+        # straggler-shaped alert on THIS replica requests the agreed
+        # stop with an evict marker — the pod checkpoints now, the
+        # elastic agent re-meshes without the slow host.
+        self._evict_requested = None
+        if (self.obs.watchdog is not None
+                and cfg.obs.evict_on_straggler):
+            def _evict(record):
+                if self._evict_requested is None:
+                    # Claim at ALERT time (first claim wins — several
+                    # replicas' watchdogs may fire near-simultaneously
+                    # under a pod-wide slowdown): the claimer is the
+                    # evicted replica; everyone still requests the
+                    # agreed stop so the pod checkpoints together.
+                    claimed = elastic_events.write_evict_marker(
+                        cfg.checkpoint.directory,
+                        process_index=jax.process_index(),
+                        host=elastic_events.agent_host(),
+                        reason=str(record.get("reason", "straggler")),
+                        detail=record)
+                    print(f"[process {jax.process_index()}] EVICT "
+                          f"{'claimed' if claimed else 'joined'} "
+                          f"after watchdog alert: {record}",
+                          flush=True)
+                    self._evict_requested = record
+                    self.guard.request()
+            self.obs.watchdog.on_evict = _evict
         if jax.process_count() > 1 and self.obs.watchdog is not None:
             # Multi-host --halt-on-unhealthy: a fatal alert on any one
             # process must not raise there (the others would wedge in
@@ -343,29 +401,57 @@ class Trainer:
     # Multi-host preemption polling period (steps). The agreement
     # collective blocks the host, so it runs every K steps, in lockstep
     # on all hosts; a preemption grace window is tens of seconds, far
-    # longer than K steps.
-    STOP_POLL_STEPS = 16
+    # longer than K steps. Env-overridable (TPUNET_STOP_POLL_STEPS) so
+    # the chaos harness can exercise agreed stops inside tiny epochs
+    # (docs/elasticity.md).
+    STOP_POLL_STEPS = int(os.environ.get("TPUNET_STOP_POLL_STEPS", "16"))
+
+    def _agree_stop(self, tag: str) -> bool:
+        """Cross-host OR of the local stop flag. Routed through the
+        coordination-service KV store (tpunet/parallel/dist.agree_any)
+        because this runs CONCURRENTLY with the async checkpoint
+        worker's orbax cross-host barriers — two XLA host collectives
+        from two threads interleave differently per process and abort
+        the transport (the gloo preamble crash the chaos evict leg
+        reproduced). Allgather remains the no-coordination-service
+        fallback, where no concurrent orbax barriers can exist."""
+        from tpunet.parallel.dist import agree_any
+        stop = agree_any(tag, self.guard.requested)
+        if stop is None:
+            from jax.experimental import multihost_utils
+            import jax.numpy as jnp
+            flags = multihost_utils.process_allgather(
+                jnp.asarray(self.guard.requested))
+            stop = bool(np.asarray(flags).any())
+        if stop:
+            self.guard.request()  # keep local flag consistent for train()
+        return stop
 
     def _stop_agreed(self) -> bool:
         """Cross-host-agreed preemption decision. The signal flag is
         process-local; if hosts diverged on it, the ones still issuing
         the sharded train step would deadlock in its collectives and the
-        multi-host Orbax save would wedge. All hosts allgather their
-        flags in lockstep (every STOP_POLL_STEPS steps) and stop if ANY
-        host was signalled — per-VM spot preemption hits workers too,
-        not just the coordinator."""
+        multi-host Orbax save would wedge. All hosts agree in lockstep
+        (every STOP_POLL_STEPS steps) and stop if ANY host was
+        signalled — per-VM spot preemption hits workers too, not just
+        the coordinator."""
         if jax.process_count() == 1:
             return self.guard.requested
         if self.global_step % self.STOP_POLL_STEPS:
             return False
-        from jax.experimental import multihost_utils
-        import jax.numpy as jnp
-        flags = multihost_utils.process_allgather(
-            jnp.asarray(self.guard.requested))
-        stop = bool(np.asarray(flags).any())
-        if stop:
-            self.guard.request()  # keep local flag consistent for train()
-        return stop
+        return self._agree_stop(f"stop/{self.global_step}")
+
+    def _epoch_stop_agreed(self, epoch: int) -> bool:
+        """Epoch-boundary stop agreement. The in-loop ``_stop_agreed``
+        only polls every STOP_POLL_STEPS, so a signal landing in the
+        final stretch of an epoch can leave hosts DIVERGED at the
+        epoch boundary: the signalled host would take the partial-save
+        path (a collective orbax save) while the rest enter eval —
+        deadlock. One agreement per epoch, run by every host in
+        lockstep right after the epoch, closes that hole."""
+        if jax.process_count() == 1:
+            return self.guard.requested
+        return self._agree_stop(f"estop/{epoch}")
 
     def train_one_epoch(self, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
@@ -400,6 +486,13 @@ class Trainer:
                 # end-of-epoch summarize() is the window-edge sync).
                 obs.before_step(self.global_step, sync)
                 step_timer.lap()
+                if self._chaos is not None:
+                    # Fault injection fires INSIDE the measured step
+                    # window, host-side: SIGKILL/SIGTERM/slow-host
+                    # land exactly where real faults strike — and an
+                    # injected straggler delay shows up in step_time_s
+                    # where the watchdog's stall detector looks.
+                    self._chaos.step(self.global_step)
                 with obs.step_span(self.global_step):
                     gx, gy = shard_host_batch(self.mesh, bx,
                                               by.astype(np.int32))
@@ -407,6 +500,8 @@ class Trainer:
                                                     rng)
                 obs.observe_step(self.global_step, step_timer.lap())
             else:
+                if self._chaos is not None:
+                    self._chaos.step(self.global_step)
                 gx, gy = shard_host_batch(self.mesh, bx,
                                           by.astype(np.int32))
                 self.state, m = self.train_step(self.state, gx, gy, rng)
@@ -533,6 +628,20 @@ class Trainer:
         # metrics.jsonl; MetricsLogger already restricts writes to the
         # coordinator.
         self.obs.add_sink(JsonlSink(metrics_log))
+        if (self.obs.enabled and self._elastic is not None
+                and self._elastic["generation"] > 0):
+            # A re-meshed incarnation: the recovery record that pairs
+            # with the agent's shrink/grow/restart — same run_id, the
+            # NEW mesh, and the restore stamp that proves which
+            # checkpoint carried the run across (docs/elasticity.md).
+            self.obs.registry.emit(
+                "obs_elastic", elastic_events.build_elastic_record(
+                    "recovered",
+                    generation=self._elastic["generation"],
+                    new_world=jax.process_count(),
+                    old_mesh=self._prev_mesh,
+                    new_mesh=mesh_shape_dict(self.mesh),
+                    epoch=self.start_epoch, step=self.global_step))
         # The PLAIN epoch records below bypass Registry.emit, so stamp
         # them here: without identity the fleet aggregator would file
         # them under a junk per-file stream instead of this run's.
@@ -565,12 +674,47 @@ class Trainer:
                         f"epoch {epoch}; the last completed checkpoint "
                         f"is still finite — resume from it with a lower "
                         f"--lr or with --clip-norm")
-                if self.guard.requested:
+                if self._epoch_stop_agreed(epoch):
+                    if self.guard.escalated:
+                        # Second SIGTERM inside the grace window: the
+                        # platform is saying NOW. Best-effort abandon:
+                        # no save, no durability wait — a save that
+                        # gets SIGKILLed mid-write is strictly worse
+                        # than resuming from the last intact
+                        # checkpoint (which is exactly what --resume
+                        # does).
+                        flightrec.record(
+                            "train", f"escalated preemption epoch="
+                                     f"{epoch}")
+                        log0(f"Second preemption signal at epoch "
+                             f"{epoch} (step {self.global_step}); "
+                             "abandoning checkpoint work and exiting "
+                             "immediately")
+                        self.start_epoch = epoch
+                        self.ckpt.abandon()
+                        break
                     # Preempted mid-epoch: persist the advanced state,
                     # marked partial so --resume re-runs this epoch's
                     # remaining data instead of skipping it.
                     flightrec.record("train", f"preemption epoch="
                                               f"{epoch}")
+                    if self._evict_requested is not None:
+                        # The agreed stop is an EVICT (marker already
+                        # claimed at alert time); emit the
+                        # obs_elastic breadcrumb that explains it
+                        # (record-first: the straggler obs_alert is
+                        # already in the stream).
+                        if self.obs.enabled:
+                            self.obs.registry.emit(
+                                "obs_elastic",
+                                elastic_events.build_elastic_record(
+                                    "evict_requested",
+                                    cause=str(
+                                        self._evict_requested.get(
+                                            "reason", "straggler")),
+                                    epoch=epoch,
+                                    step=self.global_step,
+                                    detail=self._evict_requested))
                     log0(f"Preemption requested at epoch {epoch} (step "
                          f"{self.global_step}); "
                          + ("saving state and exiting"
@@ -658,12 +802,26 @@ class Trainer:
                     epoch=epoch, step=self.global_step,
                     units=train_m["count"], train_seconds=train_secs,
                     eval_seconds=secs - train_secs)
+            else:
+                # Every epoch completed (no preemption/evict break):
+                # tell the elastic agents the run is DONE, not
+                # preempted — without this a supervising agent would
+                # faithfully relaunch a finished run.
+                if self._elastic is not None \
+                        and jax.process_index() == 0:
+                    elastic_events.mark_done(cfg.checkpoint.directory)
         finally:
             self.guard.uninstall()
         log0("")
         for line in summary_lines(self.best_acc, total.elapsed()):
             log0(line)
-        self.ckpt.wait()
+        if self.guard.escalated:
+            self.ckpt.abandon()
+        else:
+            # Durability barrier, bounded by whatever remains of the
+            # preemption grace window (unbounded on a normal exit or
+            # without --preempt-grace-s).
+            self.ckpt.wait(timeout=self.guard.remaining())
         return self.history
 
     def close(self) -> None:
