@@ -156,11 +156,316 @@ def run_http_level(base, concurrency, *, prompt_len, new_tokens,
     }
 
 
+def run_cold_start_child(args) -> None:
+    """Hidden mode (--_cold-start-child): build the engine in THIS
+    fresh process and print cold_start_to_first_token_s — the wall
+    time from engine construction (weights already initialized; that
+    cost is variant-independent) to the first generated token. The
+    parent controls what is warm: JAX_COMPILATION_CACHE_DIR in the
+    environment, the AOT store via --_aot-dir."""
+    import jax
+
+    from tpunet.config import ModelConfig, ServeConfig
+    from tpunet.models import create_model, init_variables
+    from tpunet.serve.engine import Engine, build_aot_store
+    from tpunet.utils.cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+    model_cfg = ModelConfig(
+        name="lm", vit_hidden=args.vit_hidden, vit_depth=args.vit_depth,
+        vit_heads=args.vit_heads, vocab_size=args.vocab_size,
+        max_seq_len=args.max_seq_len, dropout_rate=0.0, dtype="float32")
+    bucket = 1 << max(4, (args.prompt_len - 1).bit_length())
+    cfg = ServeConfig(slots=args.slots, queue_max=64,
+                      prefill_buckets=(min(bucket, args.max_seq_len),),
+                      emit_every_s=0.0)
+    model = create_model(model_cfg)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=16)
+    store = None
+    if args._aot_dir:
+        store = build_aot_store(args._aot_dir, model_cfg, cfg)
+    t0 = time.perf_counter()
+    engine = Engine(model, variables, cfg, aot_store=store).start()
+    try:
+        req = engine.submit(np.zeros(args.prompt_len, np.int32),
+                            max_new_tokens=1)
+        req.result(timeout=600)
+        cold_start = req.first_token_t - t0
+    finally:
+        engine.stop()
+    print(json.dumps({
+        "cold_start_to_first_token_s": round(cold_start, 3),
+        "aot_status": engine.aot_status,
+        "device": jax.devices()[0].device_kind}))
+
+
+def _cold_start_variant(argv_base, *, cache_dir, aot_dir=""):
+    """One fresh-process boot measurement."""
+    import subprocess
+    env = dict(os.environ, JAX_COMPILATION_CACHE_DIR=cache_dir)
+    argv = argv_base + ["--_cold-start-child"]
+    if aot_dir:
+        argv += ["--_aot-dir", aot_dir]
+    out = subprocess.run(argv, env=env, capture_output=True, text=True,
+                         timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"cold-start child failed (rc "
+                           f"{out.returncode}):\n{out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_cold_start_bench(args) -> dict:
+    """Measure cold_start_to_first_token_s for the three boot modes
+    (fresh process each — an in-process A/B would hit jax's live jit
+    caches):
+
+    - ``cold``       — empty persistent compilation cache, no AOT;
+    - ``persistent`` — the compilation cache the cold boot populated;
+    - ``aot``        — deserialized AOT executables against an EMPTY
+      compilation cache, so only the AOT store contributes.
+
+    The acceptance bar (and the serve-budget gate): aot < cold, and
+    aot under the checked-in ceiling."""
+    import tempfile
+
+    base = [sys.executable, os.path.abspath(__file__),
+            "--vit-hidden", str(args.vit_hidden),
+            "--vit-depth", str(args.vit_depth),
+            "--vit-heads", str(args.vit_heads),
+            "--vocab-size", str(args.vocab_size),
+            "--max-seq-len", str(args.max_seq_len),
+            "--slots", str(args.slots),
+            "--prompt-len", str(args.prompt_len)]
+    with tempfile.TemporaryDirectory() as tmp:
+        cache1 = os.path.join(tmp, "cache1")
+        cache2 = os.path.join(tmp, "cache2")
+        aot = os.path.join(tmp, "aot")
+        os.makedirs(cache1)
+        os.makedirs(cache2)
+        cold = _cold_start_variant(base, cache_dir=cache1)
+        persistent = _cold_start_variant(base, cache_dir=cache1)
+        # Prepare the AOT store (timing discarded), then boot from it
+        # with a cache dir that has never seen these programs.
+        _cold_start_variant(base, cache_dir=cache1, aot_dir=aot)
+        aot_boot = _cold_start_variant(base, cache_dir=cache2,
+                                       aot_dir=aot)
+    assert all(v == "loaded" for v in aot_boot["aot_status"].values()), \
+        f"AOT boot did not deserialize: {aot_boot['aot_status']}"
+    record = {
+        "mode": "cold_start",
+        "device": cold["device"],
+        "slots": args.slots,
+        "prompt_len": args.prompt_len,
+        "cold_start_to_first_token_s": {
+            "cold": cold["cold_start_to_first_token_s"],
+            "persistent": persistent["cold_start_to_first_token_s"],
+            "aot": aot_boot["cold_start_to_first_token_s"],
+        },
+    }
+    if record["cold_start_to_first_token_s"]["aot"] > 0:
+        record["aot_speedup_vs_cold"] = round(
+            record["cold_start_to_first_token_s"]["cold"]
+            / record["cold_start_to_first_token_s"]["aot"], 2)
+    return record
+
+
+def _get_json(url, timeout=10):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def run_router_bench(args) -> dict:
+    """Closed-loop load through a spawned router + replica fleet with
+    one replica killed mid-run: fleet tok/s, re-route latency (kill
+    -> next completed request), dropped-request count (client-visible
+    failures — MUST be 0 for --kill-mode drain; bounded by the
+    route-retry budget for sigkill), and respawn recovery."""
+    import signal as _signal
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix="router-bench-")
+    argv = [sys.executable, "-m", "tpunet.router",
+            "--spawn", str(args.replicas), "--port", str(port),
+            "--probe-interval-s", "0.25", "--unhealthy-after", "2",
+            "--respawn-backoff-s", "0.5", "--emit-every-s", "2",
+            "--min-replicas", str(args.replicas),
+            "--metrics-dir", workdir,
+            "--aot-cache", os.path.join(workdir, "aot"), "--",
+            "--checkpoint-dir", "",
+            "--vit-hidden", str(args.vit_hidden),
+            "--vit-depth", str(args.vit_depth),
+            "--vit-heads", str(args.vit_heads),
+            "--vocab-size", str(args.vocab_size),
+            "--max-seq-len", str(args.max_seq_len),
+            "--slots", str(args.slots),
+            "--prefill-buckets", str(min(
+                1 << max(4, (args.prompt_len - 1).bit_length()),
+                args.max_seq_len))]
+    router = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                              stderr=subprocess.STDOUT)
+    out = {"mode": "router", "replicas": args.replicas,
+           "kill_mode": args.kill_mode, "workdir": workdir,
+           "errors": []}
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            try:
+                h = _get_json(base + "/healthz", timeout=2)
+                if h.get("routable", 0) >= args.replicas:
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        else:
+            out["errors"].append("fleet never became routable")
+            return out
+
+        rng = np.random.default_rng(0)
+        concurrency = max(4, args.replicas * 2)
+        n_requests = concurrency * max(4, args.requests_per_client)
+        prompts = [rng.integers(0, args.vocab_size,
+                                size=args.prompt_len).tolist()
+                   for _ in range(concurrency)]
+        results = []           # (t_done, ok, tokens)
+        lock = threading.Lock()
+        kill_at = n_requests // 2
+        killed = {"t": None, "pid": None}
+
+        def kill_one():
+            rows = _get_json(base + "/replicas")["replicas"]
+            victim = next((r for r in rows
+                           if r.get("alive") and r.get("pid")), None)
+            if victim is None:
+                out["errors"].append("no live replica to kill")
+                return
+            killed["pid"] = victim["pid"]
+            killed["t"] = time.perf_counter()
+            sig = (_signal.SIGKILL if args.kill_mode == "sigkill"
+                   else _signal.SIGTERM)
+            os.kill(victim["pid"], sig)
+
+        import urllib.request
+        counter = {"n": 0}
+
+        def client(i):
+            while True:
+                with lock:
+                    if counter["n"] >= n_requests:
+                        return
+                    counter["n"] += 1
+                    seq = counter["n"]
+                if seq == kill_at and args.kill_mode != "none":
+                    kill_one()
+                body = json.dumps(
+                    {"tokens": prompts[i],
+                     "max_new_tokens": args.new_tokens}).encode()
+                req = urllib.request.Request(
+                    base + "/v1/generate", body,
+                    {"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=600) as r:
+                        payload = json.loads(r.read())
+                    with lock:
+                        results.append((time.perf_counter(), True,
+                                        len(payload["tokens"])))
+                except Exception:  # noqa: BLE001 — a failed request
+                    with lock:     # is the measurement, not a crash
+                        results.append((time.perf_counter(), False, 0))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        ok = [r for r in results if r[1]]
+        dropped = len(results) - len(ok)
+        total_tokens = sum(r[2] for r in ok)
+        out.update({
+            "requests": len(results),
+            "dropped_requests": dropped,
+            "total_tokens": total_tokens,
+            "wall_s": round(wall, 3),
+            "fleet_tokens_per_s": round(total_tokens / wall, 1),
+        })
+        if killed["t"] is not None:
+            after = [t for t, good, _ in results
+                     if good and t > killed["t"]]
+            if after:
+                out["reroute_latency_s"] = round(
+                    min(after) - killed["t"], 3)
+            # Respawn recovery: every replica routable again.
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                try:
+                    h = _get_json(base + "/healthz", timeout=2)
+                    if h.get("routable", 0) >= args.replicas:
+                        out["respawn_recovery_s"] = round(
+                            time.perf_counter() - killed["t"], 3)
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.5)
+            else:
+                out["errors"].append("killed replica never respawned")
+        try:
+            snap = _get_json(base + "/metrics")
+            for key in ("router_requests_total", "router_rerouted_total",
+                        "router_rejected_total",
+                        "router_evictions_total",
+                        "router_respawns_total"):
+                if key in snap:
+                    out[key] = int(snap[key])
+        except Exception:  # noqa: BLE001
+            pass
+        if args.kill_mode == "drain" and dropped:
+            out["errors"].append(
+                f"drain kill dropped {dropped} request(s); drain must "
+                "drop zero")
+    finally:
+        router.send_signal(_signal.SIGTERM)
+        try:
+            router.wait(timeout=90)
+        except subprocess.TimeoutExpired:
+            router.kill()
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--http", default="",
                     help="bench a RUNNING server at this base URL "
                          "instead of an in-process engine")
+    ap.add_argument("--cold-start", action="store_true",
+                    help="measure cold_start_to_first_token_s for "
+                         "cold / persistent-cache / AOT-deserialized "
+                         "replica boots (fresh subprocess each)")
+    ap.add_argument("--_cold-start-child", action="store_true",
+                    dest="_cold_start_child", help=argparse.SUPPRESS)
+    ap.add_argument("--_aot-dir", default="", dest="_aot_dir",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--router", action="store_true",
+                    help="closed-loop load against a spawned router + "
+                         "replica fleet with a mid-run replica kill "
+                         "(fleet tok/s, re-route latency, dropped "
+                         "requests)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="--router: replica children to spawn")
+    ap.add_argument("--kill-mode", default="sigkill",
+                    choices=("sigkill", "drain", "none"),
+                    help="--router: how the mid-run replica dies "
+                         "(drain = SIGTERM graceful; dropped "
+                         "requests must be 0 for drain, bounded for "
+                         "sigkill)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="LM best checkpoint (default: random tiny "
                          "weights — throughput shape, not quality)")
@@ -183,6 +488,37 @@ def main() -> None:
                          "device kind")
     args = ap.parse_args()
     levels = [int(c) for c in args.concurrency.split(",") if c]
+
+    if args._cold_start_child:
+        run_cold_start_child(args)
+        return
+
+    if args.cold_start:
+        out = run_cold_start_bench(args)
+        print(json.dumps(out, indent=1))
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        if args.enforce_budget:
+            from check_serve_budget import check_record, load_budget
+            ok, msgs = check_record(out, load_budget())
+            for m in msgs:
+                print(f"# {m}", file=sys.stderr, flush=True)
+            if not ok:
+                sys.exit(3)
+        return
+
+    if args.router:
+        out = run_router_bench(args)
+        print(json.dumps(out, indent=1))
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        if out.get("errors"):
+            sys.exit(1)
+        return
 
     if args.http:
         if args.enforce_budget:

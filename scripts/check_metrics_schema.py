@@ -313,6 +313,56 @@ def collect_elastic_records(tmpdir: str) -> list:
     return records + sink.records
 
 
+def collect_router_records() -> list:
+    """obs_router via the factored builders (no replicas needed — the
+    builders ARE the record shapes): one window record with live
+    counters/histograms + per-replica rows, plus every event flavor
+    the control loop emits."""
+    from tpunet.obs.registry import MemorySink, Registry
+    from tpunet.router.records import (build_router_event,
+                                       build_router_record)
+
+    reg = Registry()
+    reg.set_identity(run_id="router-check", process_index=0, host="h")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    for name in ("requests", "rerouted", "rejected", "affinity_hits",
+                 "evictions", "respawns", "scale_ups", "scale_downs",
+                 "probe_failures"):
+        reg.counter(f"router_{name}_total").inc(2)
+    for i in range(5):
+        reg.histogram("router_e2e_s").observe(0.02 * (i + 1))
+    replicas = [
+        {"name": "r0", "url": "http://127.0.0.1:8000",
+         "state": "healthy", "run_id": "router-replica-0", "slots": 8,
+         "queue_depth": 1, "active_slots": 2,
+         "serve_requests_total": 9, "requests_routed": 5,
+         "requests_failed": 0, "fail_streak": 0},
+        {"name": "r1", "url": "http://127.0.0.1:8001",
+         "state": "dead", "run_id": "router-replica-1", "slots": 8,
+         "queue_depth": 0, "active_slots": 0,
+         "serve_requests_total": 4, "requests_routed": 4,
+         "requests_failed": 1, "fail_streak": 3},
+    ]
+    record = build_router_record(
+        reg, replicas=replicas, uptime_s=30.0, window_s=10.0,
+        scale_decision="scale_up", ttft_slo_burn=1.25, final=True)
+    reg.emit("obs_router", record)
+    reg.emit("obs_router", build_router_event(
+        "evict", replica="r1", url="http://127.0.0.1:8001",
+        cause="webhook:straggler",
+        detail={"kind": "obs_alert", "reason": "straggler"}))
+    reg.emit("obs_router", build_router_event(
+        "respawn", replica="r1", url="http://127.0.0.1:8002",
+        cause="evicted"))
+    reg.emit("obs_router", build_router_event(
+        "scale_up", cause="policy", old_replicas=2, new_replicas=3))
+    reg.emit("obs_router", build_router_event(
+        "scale_down", replica="r0", cause="policy", old_replicas=3,
+        new_replicas=2))
+    return sink.records
+
+
 def collect_agg_records() -> list:
     """obs_fleet + every fleet obs_alert reason via a two-stream
     aggregator (one straggling, one leaking, both serving)."""
@@ -369,6 +419,22 @@ def collect_agg_records() -> list:
                 "severity": "warn", "cause": "host_lost",
                 "generation": 2, "old_world": 2, "new_world": 1,
                 "time": 1234.5})          # elastic_* rollup fields
+    agg.ingest({"kind": "obs_router", "run_id": "router-a",
+                "process_index": 0, "uptime_s": 30.0, "window_s": 10.0,
+                "replicas": 2, "replicas_healthy": 1,
+                "replicas_draining": 0, "replicas_dead": 1,
+                "fleet_queue_depth": 3, "fleet_active_slots": 2,
+                "fleet_slots": 16, "requests_total": 9,
+                "rerouted_total": 1, "rejected_total": 0,
+                "affinity_hits_total": 4, "evictions_total": 1,
+                "respawns_total": 1, "scale_ups_total": 0,
+                "scale_downs_total": 0, "probe_failures_total": 3,
+                "scale_decision": "hold",
+                "per_replica": []})       # router_* rollup fields
+    agg.ingest({"kind": "obs_router", "run_id": "router-a",
+                "process_index": 0, "event": "evict", "replica": "r1",
+                "severity": "warn", "cause": "probe_failures",
+                "time": 1234.6})          # router_last_event
     agg.emit_rollup()           # straggler + mem_growth + rules + crash
     clock.t += 100.0
     agg.emit_rollup()           # stream_stale for every stream
@@ -402,6 +468,7 @@ def main() -> int:
     with tempfile.TemporaryDirectory() as tmp:
         records += collect_crash_records(tmp)
     records += collect_serve_records()
+    records += collect_router_records()
     records += collect_agg_records()
     records += collect_regression_records()
     with tempfile.TemporaryDirectory() as tmp:

@@ -78,6 +78,41 @@ def tokens_per_s_per_slot(record: Dict):
     return max(rates) / slots
 
 
+def check_cold_start(record: Dict, key: str, entry: Dict,
+                     tol: float) -> Tuple[bool, List[str]]:
+    """Gate a ``bench_serve.py --cold-start`` record: the
+    AOT-deserialized boot must (a) beat the cold boot outright — the
+    invariant that makes seconds-scale autoscaling real — and (b)
+    stay under the checked-in ceiling (a LATENCY: gated from ABOVE,
+    ceiling * (1 + tolerance))."""
+    times = record.get("cold_start_to_first_token_s") or {}
+    aot = times.get("aot")
+    cold = times.get("cold")
+    msgs: List[str] = []
+    ok = True
+    if aot is None or cold is None:
+        return True, [f"{key}: cold-start record has no aot/cold "
+                      "measurement; skipping"]
+    if aot >= cold:
+        ok = False
+        msgs.append(f"{key}: AOT boot {aot:.3f}s did not beat cold "
+                    f"boot {cold:.3f}s [REGRESSION]")
+    ceiling = entry.get("cold_start_to_first_token_s_aot")
+    if ceiling is None:
+        msgs.append(f"{key}: no cold_start_to_first_token_s_aot "
+                    "ceiling; aot-beats-cold only")
+        return ok, msgs
+    limit = ceiling * (1.0 + tol)
+    within = aot <= limit
+    ok = ok and within
+    msgs.append(
+        f"{key}: cold_start_to_first_token_s aot {aot:.3f}s vs "
+        f"ceiling {ceiling:.3f}s (+{100 * tol:.0f}% tolerance -> "
+        f"limit {limit:.3f}s) "
+        f"[{'OK' if within else 'REGRESSION'}]")
+    return ok, msgs
+
+
 def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
     """-> (ok, messages). ok is False only on a real throughput drop;
     a missing budget entry or an unmeasurable record passes with a
@@ -88,6 +123,8 @@ def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
     if entry is None:
         return True, [f"no serve budget for device kind {kind.lower()!r}; "
                       "nothing to enforce"]
+    if record.get("mode") == "cold_start":
+        return check_cold_start(record, key, entry, tol)
     budgeted = entry.get("tokens_per_s_per_slot")
     measured = tokens_per_s_per_slot(record)
     if budgeted is None:

@@ -427,6 +427,12 @@ def render_fleet_terminal(rollup: dict, ages: dict, source: str,
                     + (f" (last {last}"
                        + (f", gen {gen}" if gen is not None else "")
                        + ")" if last else ""))
+    if rollup.get("routers"):
+        last = rollup.get("router_last_event", "")
+        head.append(
+            f"ROUTER {rollup.get('router_replicas_healthy', 0)}"
+            f"/{rollup.get('router_replicas', 0)} healthy"
+            + (f" (last {last})" if last else ""))
     out.append("  ".join(head))
     out.append("")
 
@@ -474,6 +480,17 @@ def render_fleet_terminal(rollup: dict, ages: dict, source: str,
                 f"({100 * (r.get('delta_frac') or 0):+.1f}%)")
         out.append("")
 
+    if rollup.get("routers"):
+        out.append(
+            f"router: {rollup.get('router_replicas_healthy', 0)}"
+            f"/{rollup.get('router_replicas', 0)} replicas healthy  "
+            f"queue {rollup.get('router_fleet_queue_depth', 0)}  "
+            f"evictions {rollup.get('router_evictions_total', 0)}  "
+            f"respawns {rollup.get('router_respawns_total', 0)}  "
+            f"scale +{rollup.get('router_scale_ups_total', 0)}"
+            f"/-{rollup.get('router_scale_downs_total', 0)}"
+            + (f"  last {rollup['router_last_event']}"
+               if rollup.get("router_last_event") else ""))
     if rollup.get("serve_replicas"):
         out.append(
             f"serve: {rollup['serve_replicas']} replicas  "
@@ -524,6 +541,15 @@ def render_fleet_html(rollup: dict, streams, source: str,
         last = rollup.get("elastic_last_event", "")
         tile(rollup["elastic_events_total"],
              f"elastic events{f' (last {last})' if last else ''}")
+    if rollup.get("routers"):
+        last = rollup.get("router_last_event", "")
+        tile(f"{rollup.get('router_replicas_healthy', 0)}"
+             f"/{rollup.get('router_replicas', 0)}",
+             f"router replicas{f' (last {last})' if last else ''}")
+        if rollup.get("router_evictions_total") is not None:
+            tile(f"{rollup.get('router_evictions_total', 0)}"
+                 f"/{rollup.get('router_respawns_total', 0)}",
+                 "router evictions/respawns")
 
     cards = []
     # Per-stream step-time trend: one line per stream, shared y scale.

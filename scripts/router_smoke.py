@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Fast router smoke: the control plane against stub replicas.
+
+Exercises the routing tier with NO engine, NO model, NO device —
+stdlib HTTP stubs play the replicas — so the gate runs in seconds
+and failures point at router logic, not at jax. Five legs:
+
+1. least-loaded routing spreads requests by probed load;
+2. a dead replica is re-routed around (no client-visible failure)
+   and evicted after its failure budget;
+3. a draining replica's 503 + Retry-After is honored (backed off,
+   traffic lands elsewhere, zero drops);
+4. an AlertWebhook page (straggler) POSTed to /webhook evicts the
+   named replica;
+5. the obs_router window record reconciles with what was routed.
+
+Wired into scripts/run_checks.sh (fast set). Exit 0 = all legs pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+class StubReplica:
+    """Stdlib stand-in for one tpunet.serve replica."""
+
+    def __init__(self, run_id: str, *, slots: int = 4):
+        self.run_id = run_id
+        self.slots = slots
+        self.queue_depth = 0
+        self.requests = 0
+        self.draining = False
+        self.retry_after = 5
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+            def _json(self, code, obj, headers=()):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    if stub.draining:
+                        self._json(503, {"status": "draining",
+                                         "run_id": stub.run_id},
+                                   [("Retry-After",
+                                     str(stub.retry_after))])
+                    else:
+                        self._json(200, {
+                            "status": "ok", "run_id": stub.run_id,
+                            "slots": stub.slots,
+                            "queue_depth": stub.queue_depth,
+                            "active_slots": 0})
+                elif self.path == "/metrics":
+                    self._json(200, {
+                        "serve_queue_depth": stub.queue_depth,
+                        "serve_active_slots": 0,
+                        "serve_requests_total": stub.requests})
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if stub.draining:
+                    self._json(503, {"error": "draining"},
+                               [("Retry-After",
+                                 str(stub.retry_after))])
+                    return
+                stub.requests += 1
+                self._json(200, {"tokens": [1, 2],
+                                 "finish_reason": "length",
+                                 "served_by": stub.run_id})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def post(base, path, obj, timeout=10):
+    req = urllib.request.Request(
+        base + path, json.dumps(obj).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(base, path, timeout=10):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def wait_for(pred, timeout=15.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main() -> int:
+    from tpunet.config import RouterConfig
+    from tpunet.obs.registry import MemorySink
+    from tpunet.router import Router, RouterServer
+
+    stubs = [StubReplica(f"stub-{i}") for i in range(3)]
+    cfg = RouterConfig(probe_interval_s=0.1, probe_timeout_s=1.0,
+                       unhealthy_after=2, emit_every_s=0.0,
+                       boot_timeout_s=2.0, affinity_prefix=0)
+    router = Router(cfg, replica_urls=[s.url for s in stubs])
+    sink = MemorySink()
+    router.registry.add_sink(sink)
+    server = RouterServer(router, port=0).start()
+    base = f"http://127.0.0.1:{server.port}"
+    failures = []
+
+    def leg(name, fn):
+        try:
+            fn()
+            print(f"[PASS] {name}")
+        except Exception as e:  # noqa: BLE001
+            failures.append(name)
+            print(f"[FAIL] {name}: {type(e).__name__}: {e}")
+
+    def leg1():
+        wait_for(lambda: router.healthy_count() == 3, what="3 healthy")
+        stubs[0].queue_depth = 8      # heavily loaded
+        wait_for(lambda: next(r for r in router.replicas
+                              if r.run_id == "stub-0").queue_depth == 8,
+                 what="probe to see load")
+        for _ in range(6):
+            code, out = post(base, "/v1/generate", {"tokens": [1]})
+            assert code == 200
+            assert out["served_by"] != "stub-0", \
+                "routed to the loaded replica"
+        stubs[0].queue_depth = 0
+
+    def leg2():
+        stubs[1].close()              # hard-dead replica
+        for _ in range(4):
+            code, out = post(base, "/v1/generate", {"tokens": [2]})
+            assert code == 200, "re-route must hide the dead replica"
+        wait_for(lambda: any(r.state in ("dead", "evicted")
+                             for r in router.replicas),
+                 what="eviction of the dead replica")
+
+    def leg3():
+        stubs[2].draining = True
+        for _ in range(4):
+            code, out = post(base, "/v1/generate", {"tokens": [3]})
+            assert code == 200
+            assert out["served_by"] == "stub-0", \
+                f"expected stub-0, got {out['served_by']}"
+        target = next(r for r in router.replicas
+                      if r.run_id == "stub-2")
+        wait_for(lambda: target.backoff_until > 0,
+                 what="Retry-After backoff recorded")
+        stubs[2].draining = False
+
+    def leg4():
+        code, out = post(base, "/webhook", {
+            "source": "tpunet", "kind": "obs_alert",
+            "reason": "straggler", "severity": "warn",
+            "run_id": "stub-0", "detail": {}})
+        assert code == 200 and out["accepted"], out
+        target = next(r for r in router.replicas
+                      if r.run_id == "stub-0")
+        assert target.state == "evicted", target.state
+        # An unrelated page is acknowledged without action.
+        code, out = post(base, "/webhook", {
+            "kind": "obs_alert", "reason": "loss_spike",
+            "run_id": "stub-2"})
+        assert code == 200 and not out["accepted"]
+
+    def leg5():
+        router.emit_record(final=True)
+        windows = [r for r in sink.records
+                   if r.get("kind") == "obs_router"
+                   and not r.get("event")]
+        assert windows, "no obs_router window record"
+        win = windows[-1]
+        routed = sum(row["requests_routed"]
+                     for row in win["per_replica"])
+        assert routed >= 14, f"routed {routed} < 14"
+        assert win["requests_total"] >= 14
+        events = {r.get("event") for r in sink.records
+                  if r.get("kind") == "obs_router" and r.get("event")}
+        assert "evict" in events, events
+
+    leg("least-loaded routing", leg1)
+    leg("dead-replica re-route + evict", leg2)
+    leg("drain Retry-After honored", leg3)
+    leg("webhook page evicts", leg4)
+    leg("obs_router record reconciles", leg5)
+    server.drain()
+    for s in stubs:
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001
+            pass
+    if failures:
+        print(f"router_smoke: FAILED ({', '.join(failures)})")
+        return 1
+    print("router_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

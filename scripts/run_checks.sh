@@ -80,6 +80,11 @@ else
   skip_gate "serve-budget" "no SERVE_BENCH*.json artifact (run scripts/bench_serve.py --enforce-budget to gate in-process)"
 fi
 
+# Router control plane against stdlib stub replicas (no devices, no
+# model): least-loaded routing, dead-replica re-route + evict,
+# drain Retry-After, webhook eviction, obs_router reconciliation.
+run_gate "router-smoke" python scripts/router_smoke.py
+
 run_gate "sanitizer-smoke" python scripts/check_sanitizers.py --smoke
 
 if [ "$SLOW" = 1 ]; then

@@ -1,0 +1,563 @@
+"""Routing + autoscaling front tier (tpunet/router/).
+
+Three layers, cheapest first: pure-logic units (balance, policy,
+records, supervisor argv), stub-replica integration (stdlib HTTP
+stubs play the replicas — Retry-After honoring, webhook eviction,
+re-route), and THE end-to-end acceptance test: two real
+``python -m tpunet.serve`` children behind an in-process router —
+greedy parity through the proxy, least-loaded spread, a mid-stream
+SIGKILL that the router evicts, respawns, and survives, with
+``obs_router`` records in metrics.jsonl and the fleet dashboard's
+ROUTER panel rendering them.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from tpunet.config import RouterConfig
+from tpunet.router.balance import (affinity_key, pick_replica,
+                                   preferred_replica)
+from tpunet.router.policy import SCALE_DOWN, SCALE_UP, AutoscalePolicy
+from tpunet.router.replica import (DEAD, DRAINING, EVICTED, HEALTHY,
+                                   STARTING, ReplicaHandle)
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_handle(name, *, slots=4, queue=0, active=0, state=HEALTHY,
+                clock=None):
+    h = ReplicaHandle(name, f"http://127.0.0.1:1{name[-1]}",
+                      clock=clock or time.monotonic)
+    h.state = state
+    h.slots = slots
+    h.queue_depth = queue
+    h.active_slots = active
+    return h
+
+
+# ---------------------------------------------------------------------------
+# balance
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_key_session_wins_over_prompt():
+    assert affinity_key({"session": "u1", "prompt": "x"}, 8) == "s:u1"
+    k1 = affinity_key({"prompt": "shared prefix AAAA tail1"}, 16)
+    k2 = affinity_key({"prompt": "shared prefix AAAA tail2"}, 16)
+    assert k1 == k2 and k1.startswith("p:")
+    t1 = affinity_key({"tokens": [1, 2, 3, 99]}, 3)
+    t2 = affinity_key({"tokens": [1, 2, 3, 7]}, 3)
+    assert t1 == t2 == "t:1,2,3"
+    assert affinity_key({}, 16) is None
+    assert affinity_key({"prompt": "x"}, 0) is None
+
+
+def test_pick_replica_least_loaded_and_exclude():
+    a = make_handle("r0", queue=4, active=4)   # load 2.0
+    b = make_handle("r1", queue=0, active=1)   # load 0.25
+    c = make_handle("r2", state=DEAD)
+    rep, hit = pick_replica([a, b, c])
+    assert rep is b and not hit
+    rep, _ = pick_replica([a, b, c], exclude={"r1"})
+    assert rep is a
+    rep, _ = pick_replica([c])
+    assert rep is None
+
+
+def test_affinity_sticks_until_overloaded():
+    a = make_handle("r0")
+    b = make_handle("r1")
+    key = "s:conversation-42"
+    pref = preferred_replica([a, b], key)
+    other = b if pref is a else a
+    # Balanced load: affinity wins regardless of which is least.
+    rep, hit = pick_replica([a, b], key, affinity_slack=0.5)
+    assert rep is pref and hit
+    # Preferred overloaded past the slack: least-loaded wins.
+    pref.queue_depth, pref.active_slots = 4, 4   # load 2.0
+    rep, hit = pick_replica([a, b], key, affinity_slack=0.5)
+    assert rep is other and not hit
+    # Rendezvous stability: same key, same preferred, across calls.
+    assert preferred_replica([a, b], key) is pref
+
+
+def test_rendezvous_only_moves_keys_of_the_removed_replica():
+    reps = [make_handle(f"r{i}") for i in range(4)]
+    keys = [f"s:user-{i}" for i in range(50)]
+    before = {k: preferred_replica(reps, k).name for k in keys}
+    survivors = [r for r in reps if r.name != "r2"]
+    moved = sum(1 for k in keys
+                if preferred_replica(survivors, k).name != before[k])
+    displaced = sum(1 for k in keys if before[k] == "r2")
+    assert moved == displaced   # nobody else's sessions moved
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+def _policy(clock, **kw):
+    kw.setdefault("scale_window_probes", 3)
+    kw.setdefault("scale_cooldown_s", 10.0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    return AutoscalePolicy(RouterConfig(**kw), clock=clock)
+
+
+def test_policy_hysteresis_up_then_cooldown():
+    clock = FakeClock()
+    pol = _policy(clock)
+    # Pressure must be SUSTAINED: two rounds don't fire.
+    assert pol.observe(queue_depth=16, slots=8, ttft_p99_s=None,
+                       replicas=2) is None
+    assert pol.observe(queue_depth=16, slots=8, ttft_p99_s=None,
+                       replicas=2) is None
+    assert pol.observe(queue_depth=16, slots=8, ttft_p99_s=None,
+                       replicas=2) == SCALE_UP
+    # Cooldown holds even under continued pressure.
+    for _ in range(5):
+        assert pol.observe(queue_depth=16, slots=8, ttft_p99_s=None,
+                           replicas=3) is None
+    # Sustained pressure through the cooldown fires on the first
+    # post-cooldown round.
+    clock.t += 11.0
+    assert pol.observe(queue_depth=16, slots=8, ttft_p99_s=None,
+                       replicas=3) == SCALE_UP
+
+
+def test_policy_down_requires_idle_and_min_bound():
+    clock = FakeClock()
+    pol = _policy(clock)
+    for _ in range(2):
+        assert pol.observe(queue_depth=0, slots=8, ttft_p99_s=None,
+                           replicas=2) is None
+    assert pol.observe(queue_depth=0, slots=8, ttft_p99_s=None,
+                       replicas=2) == SCALE_DOWN
+    clock.t += 11.0
+    # At min_replicas the down decision never fires.
+    for _ in range(6):
+        assert pol.observe(queue_depth=0, slots=8, ttft_p99_s=None,
+                           replicas=1) is None
+
+
+def test_policy_ttft_slo_burn_arms_scale_up():
+    clock = FakeClock()
+    pol = _policy(clock, ttft_slo_ms=100.0)
+    assert pol.slo_burn(0.25) == 2.5
+    for _ in range(2):
+        pol.observe(queue_depth=0, slots=8, ttft_p99_s=0.25,
+                    replicas=2)
+    assert pol.observe(queue_depth=0, slots=8, ttft_p99_s=0.25,
+                       replicas=2) == SCALE_UP
+
+
+def test_policy_ignores_fleet_without_capacity():
+    """Boot time (0 healthy slots) must not read as idleness — the
+    regression the first live router run caught."""
+    clock = FakeClock()
+    pol = _policy(clock)
+    for _ in range(10):
+        assert pol.observe(queue_depth=0, slots=0, ttft_p99_s=None,
+                           replicas=2) is None
+    # And the idle streak did not silently accumulate.
+    assert pol.observe(queue_depth=0, slots=8, ttft_p99_s=None,
+                       replicas=2) is None
+
+
+def test_policy_max_bound():
+    clock = FakeClock()
+    pol = _policy(clock, max_replicas=2)
+    for _ in range(6):
+        assert pol.observe(queue_depth=16, slots=8, ttft_p99_s=None,
+                           replicas=2) is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor argv + webhook matching (no processes)
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_child_argv_composition(tmp_path):
+    from tpunet.router.supervisor import Supervisor
+    sup = Supervisor(["--checkpoint-dir", "ck", "--slots", "4"],
+                     directory=str(tmp_path), aot_cache="/aot")
+    argv = sup.child_argv(1, 8123, "router-replica-1")
+    assert argv[:3] == [sys.executable, "-m", "tpunet.serve"]
+    assert argv[argv.index("--port") + 1] == "8123"
+    assert argv[argv.index("--run-id") + 1] == "router-replica-1"
+    assert argv[argv.index("--metrics-dir") + 1].endswith("replica-1")
+    assert argv[argv.index("--aot-cache") + 1] == "/aot"
+    assert argv[-4:] == ["--checkpoint-dir", "ck", "--slots", "4"]
+    # Caller-pinned --aot-cache in serve_args is not duplicated.
+    sup2 = Supervisor(["--aot-cache", "/mine"], aot_cache="/aot")
+    argv2 = sup2.child_argv(0, 1, "x")
+    assert argv2.count("--aot-cache") == 1
+
+
+def test_on_page_evicts_only_named_evictable_replica():
+    from tpunet.router.core import Router
+    cfg = RouterConfig(emit_every_s=0.0)
+    router = Router(cfg, replica_urls=["http://127.0.0.1:1",
+                                      "http://127.0.0.1:2"])
+    router.replicas[0].run_id = "router-replica-0"
+    router.replicas[0].state = HEALTHY
+    router.replicas[1].run_id = "router-replica-1"
+    router.replicas[1].state = HEALTHY
+    # Non-evict reason: acknowledged, no action.
+    assert not router.on_page({"kind": "obs_alert",
+                               "reason": "loss_spike",
+                               "run_id": "router-replica-0"})
+    assert router.replicas[0].state == HEALTHY
+    # Unknown run_id: no action.
+    assert not router.on_page({"kind": "obs_alert",
+                               "reason": "straggler",
+                               "run_id": "nobody"})
+    # The real page evicts exactly the named replica.
+    assert router.on_page({"kind": "obs_alert", "reason": "straggler",
+                           "run_id": "router-replica-1",
+                           "detail": {"factor": 3.0}})
+    assert router.replicas[1].state == EVICTED
+    assert router.replicas[0].state == HEALTHY
+    # obs_crash pages evict too; an already-evicted replica doesn't
+    # double-evict.
+    assert not router.on_page({"kind": "obs_crash",
+                               "run_id": "router-replica-1"})
+
+
+# ---------------------------------------------------------------------------
+# stub-replica integration (stdlib stubs, no engine)
+# ---------------------------------------------------------------------------
+
+
+class _Stub:
+    def __init__(self, run_id, behavior):
+        self.run_id = run_id
+        self.behavior = behavior      # dict mutated by the test
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj, headers=()):
+                b = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(b)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(b)
+
+            def do_GET(self):
+                if stub.behavior.get("draining"):
+                    self._json(503, {"status": "draining",
+                                     "run_id": stub.run_id},
+                               [("Retry-After", "30")])
+                    return
+                if self.path == "/healthz":
+                    self._json(200, {"status": "ok",
+                                     "run_id": stub.run_id,
+                                     "slots": 4, "queue_depth": 0,
+                                     "active_slots": 0})
+                else:
+                    self._json(200, {"serve_requests_total":
+                                     stub.behavior.get("served", 0)})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if stub.behavior.get("draining"):
+                    self._json(503, {"error": "draining"},
+                               [("Retry-After", "30")])
+                    return
+                stub.behavior["served"] = \
+                    stub.behavior.get("served", 0) + 1
+                self._json(200, {"tokens": [7],
+                                 "served_by": stub.run_id})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _post(base, path, obj, timeout=15):
+    req = urllib.request.Request(
+        base + path, json.dumps(obj).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _wait(pred, timeout=20.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out: {what}")
+
+
+def test_router_honors_drain_retry_after_and_no_replica_503():
+    """A draining replica's 503 + Retry-After backs it off; with every
+    replica draining, the router itself answers 503 with Retry-After
+    (the contract the ISSUE's drain satellite names)."""
+    from tpunet.router import Router, RouterServer
+    stubs = [_Stub("s0", {}), _Stub("s1", {})]
+    cfg = RouterConfig(probe_interval_s=0.1, emit_every_s=0.0,
+                       affinity_prefix=0, route_retries=2)
+    router = Router(cfg, replica_urls=[s.url for s in stubs])
+    server = RouterServer(router, port=0).start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        _wait(lambda: router.healthy_count() == 2, what="2 healthy")
+        stubs[0].behavior["draining"] = True
+        for _ in range(4):
+            code, out, _ = _post(base, "/v1/generate", {"tokens": [1]})
+            assert code == 200 and out["served_by"] == "s1"
+        handle = next(r for r in router.replicas if r.run_id == "s0")
+        assert handle.backoff_until > 0
+        stubs[1].behavior["draining"] = True
+        _wait(lambda: all(not r.routable() for r in router.replicas),
+              what="both backed off")
+        code, out, headers = _post(base, "/v1/generate",
+                                   {"tokens": [1]})
+        assert code == 503
+        assert "Retry-After" in headers
+    finally:
+        server.drain()
+        for s in stubs:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: 2 real serve replicas behind the router
+# ---------------------------------------------------------------------------
+
+TINY_ARGS = ["--vit-hidden", "32", "--vit-depth", "2",
+             "--vit-heads", "2", "--vocab-size", "256",
+             "--max-seq-len", "512"]
+
+
+def _router_server(tmp_path, n=2):
+    from tpunet.router.__main__ import build_argparser, build_server
+    argv = ["--spawn", str(n), "--port", "0",
+            "--probe-interval-s", "0.2", "--probe-timeout-s", "2",
+            "--unhealthy-after", "2", "--boot-timeout-s", "240",
+            "--respawn-backoff-s", "0.2", "--emit-every-s", "0.5",
+            "--min-replicas", str(n), "--max-replicas", str(n),
+            "--metrics-dir", str(tmp_path),
+            "--aot-cache", str(tmp_path / "aot"), "--",
+            "--checkpoint-dir", "", "--slots", "2",
+            "--prefill-buckets", "16", "--queue-max", "16",
+            "--max-new-tokens", "64"] + TINY_ARGS
+    args = build_argparser().parse_args(argv)
+    return build_server(args).start()
+
+
+def test_router_end_to_end_two_replicas(tmp_path):
+    """THE acceptance test: parity through the proxy, least-loaded
+    spread, SIGKILL mid-stream -> evict -> respawn -> next request
+    succeeds, obs_router records + dashboard panel."""
+    import jax
+
+    from tpunet.config import ModelConfig
+    from tpunet.models import create_model, init_variables
+    from tpunet.models.lm import generate
+
+    server = _router_server(tmp_path)
+    router = server.router
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        _wait(lambda: router.healthy_count() == 2, timeout=240,
+              what="both replicas healthy (cold boot)")
+
+        # -- greedy parity through the router --------------------------
+        # Children run --checkpoint-dir "" => load_lm random-inits with
+        # PRNGKey(0); the same init here is the solo reference.
+        model_cfg = ModelConfig(name="lm", vit_hidden=32, vit_depth=2,
+                                vit_heads=2, vocab_size=256,
+                                max_seq_len=512, dropout_rate=0.0)
+        model = create_model(model_cfg)
+        variables = init_variables(model, jax.random.PRNGKey(0),
+                                   seq_len=16)
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, 256, size=7).astype(np.int32)
+        code, out, _ = _post(base, "/v1/generate",
+                             {"tokens": prompt.tolist(),
+                              "max_new_tokens": 6}, timeout=120)
+        assert code == 200, out
+        solo = np.asarray(generate(model, variables, prompt[None],
+                                   n_new=6))[0, 7:]
+        assert out["tokens"] == solo.tolist(), \
+            "router proxy output diverged from solo generate"
+
+        # -- least-loaded spread ---------------------------------------
+        results = [None] * 8
+        prompts = [rng.integers(0, 256, size=5).astype(int).tolist()
+                   for _ in range(8)]
+
+        def worker(i):
+            results[i] = _post(base, "/v1/generate",
+                               {"tokens": prompts[i],
+                                "max_new_tokens": 24}, timeout=120)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert all(r is not None and r[0] == 200 for r in results)
+        rows = json.loads(urllib.request.urlopen(
+            base + "/replicas", timeout=10).read())["replicas"]
+        routed = {r["name"]: r["requests_routed"] for r in rows}
+        assert all(v >= 1 for v in routed.values()), \
+            f"least-loaded routing did not spread: {routed}"
+
+        # -- SIGKILL mid-stream -> evict -> respawn --------------------
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            json.dumps({"tokens": prompts[0], "max_new_tokens": 400,
+                        "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=120)
+        first = json.loads(resp.readline())
+        assert "token" in first
+        # The stream's owner shows active_slots > 0 on its next probe
+        # (0.2s cadence); fall back to any live replica if the stream
+        # outran the probe — the evict/respawn path is the assertion,
+        # and the kill is mid-stream either way (the 400-token stream
+        # is still flowing).
+        victim = None
+        deadline = time.monotonic() + 5.0
+        while victim is None and time.monotonic() < deadline:
+            rows = json.loads(urllib.request.urlopen(
+                base + "/replicas", timeout=10).read())["replicas"]
+            victim = next((r for r in rows
+                           if r["active_slots"] > 0 and r.get("pid")),
+                          None)
+            if victim is None:
+                time.sleep(0.05)
+        if victim is None:
+            victim = next(r for r in rows if r.get("alive"))
+        os.kill(victim["pid"], signal.SIGKILL)
+        # The stream ends (error frame or truncation) — tokens already
+        # sent are not retried; the CLIENT retry lands on the
+        # survivor.
+        try:
+            for _ in resp:
+                pass
+        except Exception:  # noqa: BLE001 — a reset IS an accepted end
+            pass
+        resp.close()
+        dead_name = victim["name"]
+        _wait(lambda: any(
+            r["name"] == dead_name and r["state"] in ("dead", "evicted",
+                                                      "starting")
+            for r in json.loads(urllib.request.urlopen(
+                base + "/replicas", timeout=10).read())["replicas"]),
+            timeout=60, what="victim evicted")
+        code, out, _ = _post(base, "/v1/generate",
+                             {"tokens": prompts[1],
+                              "max_new_tokens": 4}, timeout=120)
+        assert code == 200, f"post-kill request failed: {out}"
+        _wait(lambda: router.healthy_count() == 2, timeout=240,
+              what="victim respawned healthy (AOT warm boot)")
+        code, out, _ = _post(base, "/v1/generate",
+                             {"tokens": prompts[2],
+                              "max_new_tokens": 4}, timeout=120)
+        assert code == 200
+        snap = json.loads(urllib.request.urlopen(
+            base + "/metrics", timeout=10).read())
+        assert snap["router_evictions_total"] >= 1
+        assert snap["router_respawns_total"] >= 1
+    finally:
+        server.drain()
+
+    # -- obs_router records in metrics.jsonl ---------------------------
+    recs = [json.loads(line) for line in
+            (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    windows = [r for r in recs if r.get("kind") == "obs_router"
+               and not r.get("event")]
+    events = [r for r in recs if r.get("kind") == "obs_router"
+              and r.get("event")]
+    assert windows, "no obs_router window records in metrics.jsonl"
+    assert windows[-1]["final"]
+    assert {"evict", "respawn"} <= {e["event"] for e in events}
+    # The respawned child booted from the AOT store.
+    aot_files = os.listdir(tmp_path / "aot")
+    assert any(f.endswith(".aotx") for f in aot_files)
+
+    # -- fleet dashboard panel -----------------------------------------
+    sys.path.insert(0, SCRIPTS)
+    try:
+        dash = __import__("obs_dashboard")
+    finally:
+        sys.path.pop(0)
+    from tpunet.obs.agg import Aggregator
+    agg = Aggregator()
+    for r in recs:
+        agg.ingest(r)
+    rollup = agg.rollup()
+    assert rollup.get("routers") == 1
+    frame = dash.render_fleet_terminal(rollup, {}, "test")
+    assert "ROUTER" in frame and "router:" in frame
+
+
+def test_serve_cli_rejects_bad_prefill_buckets():
+    """Satellite: --prefill-buckets typos are loud exit-2 usage
+    errors, validated BEFORE any heavy import (the subprocess form
+    proves the full CLI path; parse unit cases ride along)."""
+    import subprocess
+
+    from tpunet.serve.__main__ import parse_prefill_buckets
+
+    assert parse_prefill_buckets("8,32", 64) == (8, 32)
+    assert parse_prefill_buckets(" 8 , 32 ", 64) == (8, 32)
+    for bad in ("8,abc", "", ",", "8,0", "8,-4", "8,128"):
+        with pytest.raises(SystemExit) as exc:
+            parse_prefill_buckets(bad, 64)
+        assert exc.value.code == 2
+    out = subprocess.run(
+        [sys.executable, "-m", "tpunet.serve", "--port", "0",
+         "--max-seq-len", "64", "--prefill-buckets", "16,notanint"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2
+    assert "not an integer" in out.stderr
+    out = subprocess.run(
+        [sys.executable, "-m", "tpunet.serve", "--port", "0",
+         "--max-seq-len", "64", "--prefill-buckets", "16,128"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2
+    assert "exceeds --max-seq-len" in out.stderr

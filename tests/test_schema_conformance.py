@@ -26,7 +26,8 @@ def test_every_emitted_kind_and_field_is_documented(capsys):
     # The harness actually exercised every layer.
     assert "obs_epoch" in out.out and "obs_serve" in out.out \
         and "obs_fleet" in out.out and "obs_alert" in out.out \
-        and "obs_crash" in out.out and "obs_elastic" in out.out
+        and "obs_crash" in out.out and "obs_elastic" in out.out \
+        and "obs_router" in out.out
 
 
 def test_thread_stalled_and_crash_reasons_emitted(tmp_path):
@@ -71,6 +72,28 @@ def test_elastic_and_ckpt_io_paths_emitted(tmp_path):
     assert any(r.get("elastic_events_total") for r in rollups)
     assert any(r.get("elastic_last_event") == "shrink"
                for r in rollups)
+
+
+def test_router_records_emitted_and_rolled_up():
+    """obs_router flows through the real builders (window + every
+    event flavor) and the fleet aggregator rolls routers up."""
+    checker = _import_checker()
+    records = checker.collect_router_records()
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["obs_router"] * 5
+    window = records[0]
+    assert window["final"] and window["replicas"] == 2
+    assert window["per_replica"][0]["state"] == "healthy"
+    assert window["scale_decision"] == "scale_up"
+    events = {r.get("event") for r in records[1:]}
+    assert events == {"evict", "respawn", "scale_up", "scale_down"}
+    # Identity stamps every record.
+    assert all(r["run_id"] == "router-check" for r in records)
+    rollups = [r for r in checker.collect_agg_records()
+               if r.get("kind") == "obs_fleet"]
+    assert any(r.get("routers") for r in rollups)
+    assert any(r.get("router_last_event") == "evict" for r in rollups)
+    assert any(r.get("router_replicas") == 2 for r in rollups)
 
 
 def test_checker_catches_drift():

@@ -201,3 +201,82 @@ def test_bench_serve_enforce_budget_end_to_end():
     rec = json.loads(out.stdout)
     assert rec["tokens_per_s_per_slot"] > 0
     assert "tokens_per_s_per_slot" in out.stderr  # the gate's verdict line
+
+
+# ---------------------------------------------------------------------------
+# cold-start gate (bench_serve.py --cold-start records)
+# ---------------------------------------------------------------------------
+
+
+def _cold_record(cold, aot, device="cpu"):
+    return {"mode": "cold_start", "device": device,
+            "cold_start_to_first_token_s": {
+                "cold": cold, "persistent": cold, "aot": aot}}
+
+
+def _cold_budget(ceiling, tol=50):
+    return {"tolerance_pct": tol,
+            "budgets": {"cpu": {
+                "tokens_per_s_per_slot": 100.0,
+                "cold_start_to_first_token_s_aot": ceiling}}}
+
+
+def test_cold_start_under_ceiling_and_beating_cold_passes():
+    ok, msgs = check_record(_cold_record(cold=5.0, aot=0.5),
+                            _cold_budget(1.0))
+    assert ok and any("OK" in m for m in msgs)
+
+
+def test_cold_start_aot_not_beating_cold_fails():
+    """The unconditional invariant: an AOT boot slower than a cold
+    boot means the store is dead weight — fail even under the
+    ceiling."""
+    ok, msgs = check_record(_cold_record(cold=0.4, aot=0.5),
+                            _cold_budget(1.0))
+    assert not ok
+    assert any("did not beat cold" in m for m in msgs)
+
+
+def test_cold_start_over_ceiling_fails():
+    ok, msgs = check_record(_cold_record(cold=60.0, aot=2.0),
+                            _cold_budget(1.0))
+    assert not ok and any("REGRESSION" in m for m in msgs)
+
+
+def test_cold_start_no_ceiling_still_checks_aot_beats_cold():
+    budget = {"tolerance_pct": 50,
+              "budgets": {"cpu": {"tokens_per_s_per_slot": 100.0}}}
+    ok, msgs = check_record(_cold_record(cold=5.0, aot=0.5), budget)
+    assert ok and any("aot-beats-cold only" in m for m in msgs)
+    ok, _ = check_record(_cold_record(cold=0.3, aot=0.5), budget)
+    assert not ok
+
+
+def test_cold_start_missing_measurement_skips():
+    ok, msgs = check_record(
+        {"mode": "cold_start", "device": "cpu",
+         "cold_start_to_first_token_s": {}}, _cold_budget(1.0))
+    assert ok and any("skipping" in m for m in msgs)
+
+
+def test_checked_in_budget_has_cold_start_ceiling():
+    """docs/serve_budget.json carries the PR-11 cold-start ceiling the
+    --cold-start bench is gated on."""
+    budget = load_budget()
+    entry = budget["budgets"]["cpu"]
+    assert entry["cold_start_to_first_token_s_aot"] > 0
+
+
+@pytest.mark.slow
+def test_bench_serve_cold_start_end_to_end():
+    """bench_serve.py --cold-start --enforce-budget on this host: the
+    AOT boot beats the cold boot and stays under the ceiling."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serve.py"),
+         "--cold-start", "--enforce-budget"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=800)
+    assert out.returncode == 0, (out.returncode, out.stderr[-2000:])
+    rec = json.loads(out.stdout)
+    times = rec["cold_start_to_first_token_s"]
+    assert times["aot"] < times["cold"]

@@ -276,6 +276,152 @@ def test_healthz_unhealthy_after_engine_crash():
         srv.drain(timeout=10.0)
 
 
+def test_drain_under_load_finishes_stream_and_503s_new_requests():
+    """Satellite: an in-flight ndjson stream COMPLETES (finish_reason
+    length, not drain) while drain() runs, and requests arriving
+    during the drain get 503 (Retry-After semantics pinned
+    deterministically in the sibling test below)."""
+    big = ModelConfig(name="lm", vit_hidden=32, vit_depth=2,
+                      vit_heads=2, dropout_rate=0.0, dtype="float32",
+                      vocab_size=256, max_seq_len=512)
+    cfg = ServeConfig(slots=1, queue_max=4, prefill_buckets=(16,),
+                      default_max_new_tokens=300, emit_every_s=0.0,
+                      drain_timeout_s=60.0)
+    model = create_model(big)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+    srv = ServeServer(Engine(model, variables, cfg), port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    req = urllib.request.Request(
+        base + "/v1/generate",
+        json.dumps({"prompt": "hi", "max_new_tokens": 300,
+                    "stream": True}).encode(),
+        {"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=120)
+    first = json.loads(resp.readline())
+    assert "token" in first
+
+    drained = []
+    t = threading.Thread(target=lambda: drained.append(
+        srv.drain(timeout=60.0)))
+    t.start()
+    # While draining: new admissions are rejected 503, never queued.
+    saw_503 = False
+    deadline = time.perf_counter() + 30
+    while not saw_503 and time.perf_counter() < deadline:
+        try:
+            code, out = post(base, "/v1/generate",
+                             {"prompt": "x", "max_new_tokens": 2},
+                             timeout=30)
+        except (urllib.error.URLError, OSError):
+            break              # listener already closed: drain done
+        if code == 503:
+            saw_503 = True
+            assert out["error"] == "draining"
+        else:
+            time.sleep(0.005)
+    # The in-flight stream ran to completion through the drain.
+    lines = [json.loads(line) for line in resp]
+    resp.close()
+    done = ([first] + lines)[-1]
+    assert done.get("done") and done["finish_reason"] == "length", done
+    assert done["n_tokens"] == 300
+    t.join(timeout=90)
+    assert drained and drained[0], "drain did not finish clean"
+    assert saw_503, "never observed a mid-drain 503 rejection"
+
+
+def test_draining_503_carries_retry_after_header():
+    """The Retry-After contract, deterministically: queue closed =>
+    both /healthz and /v1/generate answer 503 with Retry-After."""
+    srv = make_server(drain_timeout_s=45.0)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        srv.engine._draining.set()
+        srv.engine.queue.close()
+        try:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["status"] == "draining"
+            assert int(e.headers["Retry-After"]) == 45
+        try:
+            req = urllib.request.Request(
+                base + "/v1/generate",
+                json.dumps({"prompt": "x"}).encode(),
+                {"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert int(e.headers["Retry-After"]) == 45
+    finally:
+        srv.drain(timeout=10.0)
+
+
+def test_healthz_carries_run_id():
+    """The router matches webhook pages to replicas by the run_id the
+    health probe returns."""
+    srv = make_server()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        code, health = get(base, "/healthz")
+        assert code == 200
+        assert health["run_id"].startswith("serve-")
+    finally:
+        srv.drain(timeout=10.0)
+
+
+def test_engine_aot_store_roundtrip(tmp_path):
+    """AOT warm-start parity: a second engine boot deserializes every
+    program ('loaded') and produces token-identical greedy output."""
+    from tpunet.serve.engine import build_aot_store
+
+    cfg = ServeConfig(slots=2, queue_max=4, prefill_buckets=(16,),
+                      default_max_new_tokens=8, emit_every_s=0.0)
+    model = create_model(TINY)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+    store = build_aot_store(str(tmp_path), TINY, cfg)
+    prompt = np.arange(5, dtype=np.int32)
+
+    eng = Engine(model, variables, cfg, aot_store=store).start()
+    try:
+        toks1 = eng.submit(prompt, max_new_tokens=5).result(timeout=120)
+    finally:
+        eng.stop()
+    assert all(v.startswith("compiled")
+               for v in eng.aot_status.values())
+    assert any(p.name.endswith(".aotx") for p in tmp_path.iterdir())
+
+    eng2 = Engine(model, variables, cfg, aot_store=store).start()
+    try:
+        toks2 = eng2.submit(prompt, max_new_tokens=5).result(timeout=120)
+    finally:
+        eng2.stop()
+    assert eng2.aot_status == {"w1": "loaded", "w16": "loaded"}
+    assert toks2 == toks1
+
+    # jit fallback (no store) agrees too.
+    eng3 = Engine(model, variables, cfg).start()
+    try:
+        toks3 = eng3.submit(prompt, max_new_tokens=5).result(timeout=120)
+    finally:
+        eng3.stop()
+    assert toks3 == toks1
+    # A different pool shape is a clean store MISS, never a wrong
+    # program.
+    cfg4 = ServeConfig(slots=3, queue_max=4, prefill_buckets=(16,),
+                       default_max_new_tokens=8, emit_every_s=0.0)
+    store4 = build_aot_store(str(tmp_path), TINY, cfg4)
+    eng4 = Engine(model, variables, cfg4, aot_store=store4).start()
+    try:
+        eng4.submit(prompt, max_new_tokens=2).result(timeout=120)
+    finally:
+        eng4.stop()
+    assert all(v.startswith("compiled")
+               for v in eng4.aot_status.values())
+
+
 def test_serve_cli_argparser_roundtrip():
     """The module entry point's arg surface builds a coherent config
     (no server start — just the parse + bucket plumbing)."""

@@ -15,6 +15,11 @@ for the three surfaces operators actually touch — ``ObsConfig``,
   corpus so the rule's own catalog can't satisfy the check it
   enforces.
 
+``RouterConfig`` joined the target set with the routing front tier
+(tpunet/router/): its knobs are exactly the kind operators reach for
+mid-incident (probe cadence, eviction budget, scale thresholds), so
+an unwired field there is drift at its most expensive.
+
 Fields that are deliberately not CLI-wired (derived values, research
 knobs) belong in the baseline with the reason — that is a reviewed
 decision, not drift.
@@ -30,7 +35,7 @@ from tpunet.analysis.core import (Finding, Project, Rule, call_name,
                                   const_str)
 
 TARGET_CLASSES: Tuple[str, ...] = ("ObsConfig", "ModelConfig",
-                                   "ServeConfig")
+                                   "ServeConfig", "RouterConfig")
 
 #: Historical flag renames: "Class.field" -> the flag that wires it.
 _FLAG_ALIASES: Dict[str, str] = {
@@ -78,8 +83,8 @@ def _nested_config_default(node: ast.AnnAssign) -> bool:
 class DriftRule(Rule):
     id = "R5"
     name = "config-cli-docs-drift"
-    doc = ("every ObsConfig/ModelConfig/ServeConfig field has a wired "
-           "CLI flag and a docs mention")
+    doc = ("every ObsConfig/ModelConfig/ServeConfig/RouterConfig "
+           "field has a wired CLI flag and a docs mention")
 
     def run(self, project: Project) -> List[Finding]:
         fields: List[Tuple[str, str, str, int]] = []  # cls, field, path, line

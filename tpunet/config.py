@@ -434,6 +434,76 @@ class ServeConfig:
     # Replica identity on obs_serve records (fleet SLO rollups route
     # by it). Empty = "serve-<host>-<pid>".
     run_id: str = ""
+    # AOT warm-start (--aot-cache DIR, tpunet/utils/cache.py
+    # AotProgramStore): serialize the fully-compiled decode +
+    # bucketed-prefill executables under DIR at first boot and
+    # deserialize them on every later boot — no tracing, no lowering,
+    # no XLA — so a respawned replica serves its first token in
+    # seconds instead of recompiling (the router tier's autoscaling
+    # depends on it; docs/serving.md "AOT warm-start"). Empty = off
+    # (the persistent compilation cache still applies). Single-device
+    # replicas only; ignored with --mesh-model > 1.
+    aot_cache: str = ""
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Routing + autoscaling front tier (tpunet/router/,
+    docs/serving.md "Routing & autoscaling"): a stdlib-threaded HTTP
+    proxy that spreads /v1/generate + /v1/classify over N serve
+    replicas (least-loaded with session/prefix affinity), evicts and
+    respawns unhealthy replicas, and emits hysteresis scale-up/down
+    decisions as ``obs_router`` records."""
+
+    host: str = "127.0.0.1"
+    port: int = 8100
+    # Health/load probe cadence against each replica's /healthz +
+    # /metrics; a probe slower than probe_timeout_s counts as a
+    # failure, and unhealthy_after consecutive failures evict.
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    unhealthy_after: int = 3
+    # Session/prefix affinity: requests with the same "session" field
+    # (or the same first affinity_prefix prompt tokens/bytes) hash to
+    # a stable preferred replica so shared-prompt traffic lands on
+    # warm KV — unless the preferred replica's load score exceeds the
+    # least-loaded replica's by more than affinity_slack (fraction of
+    # its pool), in which case least-loaded wins.
+    affinity_prefix: int = 16
+    affinity_slack: float = 0.5
+    # Re-route budget: a request that hits a dead/draining replica is
+    # retried against another replica up to route_retries times (only
+    # before any response byte reached the client).
+    route_retries: int = 2
+    # Per-proxied-request socket timeout toward a replica.
+    request_timeout_s: float = 600.0
+    # obs_router window record cadence (0 = final record only).
+    emit_every_s: float = 10.0
+    # Autoscale hysteresis over fleet queue depth per slot (and TTFT
+    # SLO burn when ttft_slo_ms > 0): the condition must hold for
+    # scale_window_probes consecutive probe rounds to fire, and after
+    # any action the policy holds for scale_cooldown_s.
+    scale_up_queue_per_slot: float = 1.0
+    scale_down_queue_per_slot: float = 0.1
+    scale_window_probes: int = 5
+    scale_cooldown_s: float = 60.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # TTFT SLO (ms): fleet TTFT p99 above it counts as SLO burn > 1
+    # and arms scale-up like queue pressure; 0 disables the term.
+    ttft_slo_ms: float = 0.0
+    # Drain-then-restart budget: SIGTERM -> graceful drain for up to
+    # this long -> SIGKILL; in-flight streams finish inside it.
+    drain_grace_s: float = 30.0
+    # Boot grace: probe failures while a replica is STARTING (loading
+    # weights, warming/deserializing programs) don't count toward
+    # eviction until this much time has passed since its (re)spawn.
+    boot_timeout_s: float = 120.0
+    # Backoff before respawning an evicted/dead replica child.
+    respawn_backoff_s: float = 1.0
+    # Router identity on obs_router records (empty =
+    # "router-<host>-<pid>").
+    run_id: str = ""
 
 
 @dataclass(frozen=True)

@@ -52,6 +52,14 @@ class StreamState:
         # Serving-side digest.
         self.last_serve: Optional[dict] = None
         self.serve_records = 0
+        # Router-tier digest (tpunet/router/): the front tier's
+        # window records and the evict/respawn/scale events it acted
+        # on — the fleet view should say who is steering, not just
+        # who is serving.
+        self.last_router: Optional[dict] = None
+        self.router_records = 0
+        self.router_events = 0
+        self.last_router_event: Optional[dict] = None
         # Elasticity digest (tpunet/elastic/): membership changes are
         # part of the stream's judgeable history — a shrink explains a
         # throughput step-change the regression panel would otherwise
@@ -94,6 +102,13 @@ class StreamState:
         elif kind == "obs_serve":
             self.last_serve = record
             self.serve_records += 1
+        elif kind == "obs_router":
+            self.router_records += 1
+            if record.get("event"):
+                self.router_events += 1
+                self.last_router_event = record
+            else:
+                self.last_router = record
         elif kind == "obs_alert":
             self.alerts += 1
             self.recent_alerts.append(record)
@@ -295,6 +310,29 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
                 out[f"serve_{key}_rank_err"] = round(
                     merge.rank_error_bound(parts), 4)
 
+    # -- router rollup ---------------------------------------------------
+    routers = [s for s in streams if s.last_router is not None
+               or s.router_events]
+    if routers:
+        out["routers"] = len(routers)
+        windows = [s.last_router for s in routers
+                   if s.last_router is not None]
+        for field in ("replicas", "replicas_healthy",
+                      "fleet_queue_depth", "fleet_slots",
+                      "evictions_total", "respawns_total",
+                      "scale_ups_total", "scale_downs_total"):
+            vals = [w.get(field) for w in windows]
+            vals = [v for v in vals if v is not None]
+            if vals:
+                out[f"router_{field}"] = sum(vals)
+        out["router_events_total"] = sum(s.router_events
+                                         for s in routers)
+        last = max((s.last_router_event for s in routers
+                    if s.last_router_event is not None),
+                   key=lambda r: r.get("time", 0) or 0, default=None)
+        if last is not None:
+            out["router_last_event"] = str(last.get("event", ""))
+
     # -- per-stream table ------------------------------------------------
     for s in streams:
         row: dict = {"stream": s.key, "records": s.records,
@@ -320,6 +358,16 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
                 row["mfu"] = s.last_epoch["mfu"]
             if s.mem_peaks:
                 row["peak_bytes_in_use"] = s.mem_peaks[-1][1]
+        if s.last_router is not None:
+            rt = s.last_router
+            for field in ("replicas", "replicas_healthy",
+                          "fleet_queue_depth", "evictions_total",
+                          "respawns_total"):
+                if rt.get(field) is not None:
+                    row[f"router_{field}"] = rt[field]
+            if s.last_router_event is not None:
+                row["router_last_event"] = str(
+                    s.last_router_event.get("event", ""))
         if s.last_serve is not None:
             sv = s.last_serve
             for field in ("queue_depth", "active_slots", "slots",

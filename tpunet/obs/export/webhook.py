@@ -42,9 +42,12 @@ from typing import Optional, Tuple
 #: the cost of one dict lookup — the "configured but idle" overhead
 #: the obs budget gate measures. obs_elastic pages because a
 #: membership change is operator-actionable (a shrink is capacity
-#: loss; a quorum failure is an outage).
+#: loss; a quorum failure is an outage). obs_router pages on its
+#: ACTION events only (evict/respawn/scale — records carrying an
+#: ``event`` field); periodic window records are fleet state, not
+#: pages, and are filtered in ``write``.
 ALERT_KINDS = ("obs_alert", "obs_crash", "obs_regression",
-               "obs_elastic")
+               "obs_elastic", "obs_router")
 
 _CLOSE = object()
 
@@ -63,6 +66,18 @@ def _summary_line(record: dict) -> str:
         return (f"tpunet regression{where}: {n} metric(s) regressed "
                 f"comparing {record.get('run_b', '?')} against "
                 f"{record.get('run_a', '?')}")
+    if kind == "obs_router":
+        event = record.get("event", "router")
+        rep = record.get("replica")
+        rep_s = f" {rep}" if rep else ""
+        worlds = ""
+        if record.get("old_replicas") is not None \
+                or record.get("new_replicas") is not None:
+            worlds = (f" replicas {record.get('old_replicas', '?')}->"
+                      f"{record.get('new_replicas', '?')}")
+        cause = record.get("cause")
+        cause_s = f" ({cause})" if cause else ""
+        return f"tpunet router {event}{where}:{rep_s}{worlds}{cause_s}"
     if kind == "obs_elastic":
         event = record.get("event", "elastic")
         worlds = ""
@@ -194,6 +209,9 @@ class AlertWebhook:
         Non-alert kinds are filtered here, before any queue work."""
         if record.get("kind") not in self.kinds:
             return
+        if record.get("kind") == "obs_router" \
+                and not record.get("event"):
+            return        # periodic window record, not a page
         if self._closed:
             self._dropped.inc()
             return

@@ -1,0 +1,47 @@
+"""Routing + autoscaling front tier over N serving replicas.
+
+The serving engine (``tpunet/serve/``) is one replica: one KV-slot
+pool, one ``/v1/generate`` endpoint. This package is the tier that
+makes a *fleet* of them look like one endpoint — and acts on the
+fleet signals the obs subsystems already produce:
+
+- ``replica``    — per-replica handle: live queue-depth/slot
+  occupancy probes (``/healthz`` + ``/metrics``), state machine
+  (starting/healthy/draining/backoff/dead/evicted), failure streaks.
+- ``balance``    — replica selection: least-loaded by probed load
+  score, with session/prefix-affinity rendezvous hashing so
+  shared-prompt traffic lands on the replica whose KV is warm.
+- ``supervisor`` — replica lifecycle: spawns ``python -m
+  tpunet.serve`` children, drain-then-restart (SIGTERM -> graceful
+  drain -> SIGKILL), respawn with backoff.
+- ``policy``     — hysteresis autoscale over fleet queue depth per
+  slot and TTFT SLO burn.
+- ``core``       — the Router: control loop (probe -> evict ->
+  respawn -> scale -> emit), ``obs_router`` records, webhook-driven
+  eviction (PR-9 ``AlertWebhook`` POSTs land on ``POST /webhook``).
+- ``frontend``   — stdlib threaded HTTP proxy: ``/v1/generate``
+  (streaming and blocking), ``/v1/classify``, ``/healthz``,
+  ``/metrics``, ``/replicas``, ``/webhook``.
+
+Cold-start is the autoscaling unlock: replicas boot with
+``--aot-cache`` (tpunet/utils/cache.py ``AotProgramStore``) so a
+scale-up or respawn serves in seconds, not a compile
+(docs/serving.md "AOT warm-start").
+
+Entry point: ``python -m tpunet.router`` (docs/serving.md
+"Routing & autoscaling").
+"""
+
+from tpunet.router.balance import affinity_key, pick_replica
+from tpunet.router.core import Router
+from tpunet.router.frontend import RouterServer
+from tpunet.router.policy import AutoscalePolicy
+from tpunet.router.records import build_router_record
+from tpunet.router.replica import ReplicaHandle
+from tpunet.router.supervisor import Supervisor
+
+__all__ = [
+    "AutoscalePolicy", "ReplicaHandle", "Router", "RouterServer",
+    "Supervisor", "affinity_key", "build_router_record",
+    "pick_replica",
+]

@@ -1,0 +1,271 @@
+"""Stdlib threaded HTTP proxy: one endpoint in front of N replicas.
+
+Endpoints:
+
+- ``POST /v1/generate`` — picked by affinity + least-loaded and
+  proxied to a replica (streaming ndjson relayed chunk-by-chunk).
+  A replica that fails or answers 503/429 BEFORE any response byte
+  reached the client is retried against another replica (up to
+  ``route_retries`` re-routes); client errors (400/413) relay
+  immediately — re-routing a bad request just fails it N times.
+- ``POST /v1/classify`` — same proxy, no affinity (stateless).
+- ``POST /webhook`` — AlertWebhook receiver: straggler / crash /
+  thread_stalled pages naming a replica's run_id evict it
+  (``--obs-webhook http://router:PORT/webhook`` on any fleet
+  dashboard or serve CLI closes the loop).
+- ``GET /healthz`` — router liveness + routable-replica count (503
+  only when the control loop died).
+- ``GET /metrics`` — the router registry snapshot (``router_*``).
+- ``GET /replicas`` — per-replica state/load/counters (the e2e tests
+  and ``bench_serve --router`` read replica request counts here).
+
+With no routable replica the router answers 503 with ``Retry-After:
+1`` — the same backpressure contract the replicas themselves speak.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpunet.obs import flightrec
+from tpunet.router.core import Router
+from tpunet.serve import httpjson
+
+
+class RouterServer:
+    """Owns the Router and the HTTP listener (``port=0`` binds an
+    ephemeral port for tests)."""
+
+    def __init__(self, router: Router, *, host: str = "127.0.0.1",
+                 port: int = 8100, metrics_logger=None, exporters=(),
+                 flight_recorder=None):
+        self.router = router
+        self.registry = router.registry
+        self._metrics_logger = metrics_logger
+        self._exporters = list(exporters)
+        self._flightrec = flight_recorder
+        self._drained = False
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._serve_thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RouterServer":
+        self.router.start()
+        # Inventory-only (stall budget 0), like the serve listener:
+        # serve_forever blocks in accept() and cannot beat.
+        flightrec.register_thread("router-http")
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="tpunet-router-http")
+        self._serve_thread.start()
+        return self
+
+    def drain(self) -> None:
+        """Stop listening, stop the control loop, drain supervised
+        children, flush sinks. Idempotent."""
+        if self._drained:
+            return
+        self._drained = True
+        flightrec.record("router", "frontend drain")
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.router.drain()
+        for exporter in self._exporters:
+            try:
+                exporter.close()
+            except Exception:  # noqa: BLE001 — a dead endpoint must
+                pass           # not block shutdown
+        if self._flightrec is not None:
+            flightrec.close(self._flightrec)
+            self._flightrec = None
+
+    close = drain
+
+
+def _make_handler(server: RouterServer):
+    router = server.router
+    cfg = router.cfg
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: D102 — metrics
+            pass                            # carry the signal
+
+        # -- helpers ---------------------------------------------------
+
+        def _json(self, code: int, obj: dict, headers=()) -> None:
+            httpjson.write_json(self, code, obj, headers)
+
+        def _read_body(self) -> dict:
+            return httpjson.read_json_body(self)
+
+        # -- GET -------------------------------------------------------
+
+        def do_GET(self):  # noqa: N802 (stdlib handler API)
+            if self.path == "/healthz":
+                routable = sum(1 for r in router.replicas
+                               if r.routable())
+                if not router.healthy:
+                    self._json(503, {
+                        "status": "unhealthy",
+                        "error": router.error or "control loop dead"})
+                else:
+                    self._json(200, {
+                        "status": "ok" if routable else "no_replicas",
+                        "replicas": len(router.replicas),
+                        "routable": routable})
+                return
+            if self.path == "/metrics":
+                self._json(200, server.registry.snapshot())
+                return
+            if self.path == "/replicas":
+                self._json(200, {"replicas": router.replicas_view()})
+                return
+            self._json(404, {"error": "not found"})
+
+        # -- POST ------------------------------------------------------
+
+        def do_POST(self):  # noqa: N802
+            try:
+                body = self._read_body()
+            except ValueError as e:
+                self._json(400, {"error": str(e)})
+                return
+            if self.path == "/v1/generate":
+                self._proxy(body, "/v1/generate",
+                            stream=bool(body.get("stream")),
+                            affine=True)
+            elif self.path == "/v1/classify":
+                self._proxy(body, "/v1/classify", stream=False,
+                            affine=False)
+            elif self.path == "/webhook":
+                accepted = router.on_page(body)
+                self._json(200, {"accepted": accepted})
+            else:
+                self._json(404, {"error": "not found"})
+
+        # -- proxying --------------------------------------------------
+
+        def _proxy(self, body: dict, path: str, *, stream: bool,
+                   affine: bool) -> None:
+            raw = json.dumps(body).encode()
+            t0 = time.perf_counter()
+            tried = set()
+            last_error = None
+            for _ in range(cfg.route_retries + 1):
+                rep, _hit = (router.pick(body, exclude=tried) if affine
+                             else router.pick({}, exclude=tried))
+                if rep is None:
+                    break
+                req = urllib.request.Request(
+                    rep.url + path, raw,
+                    {"Content-Type": "application/json"})
+                try:
+                    resp = urllib.request.urlopen(
+                        req, timeout=cfg.request_timeout_s)
+                except urllib.error.HTTPError as e:
+                    if e.code in (503, 429):
+                        # Draining / overloaded: honor Retry-After,
+                        # re-route to another replica.
+                        retry_after = float(
+                            e.headers.get("Retry-After") or 0)
+                        if retry_after > 0:
+                            rep.backoff(retry_after)
+                        e.read()
+                        e.close()
+                        tried.add(rep.name)
+                        router.note_rerouted(rep)
+                        last_error = (e.code, {"error": "replica_busy",
+                                               "replica": rep.name})
+                        continue
+                    # Client/server error from a live replica: relay
+                    # verbatim (re-routing a 400 fails it N times).
+                    router.note_routed(rep)
+                    try:
+                        payload = json.loads(e.read())
+                    except Exception:  # noqa: BLE001
+                        payload = {"error": f"replica returned {e.code}"}
+                    e.close()
+                    self._json(e.code, payload)
+                    return
+                except Exception:  # noqa: BLE001 — connection refused/
+                    # reset/timeout: the replica is gone; probe it off-
+                    # cadence and try another.
+                    tried.add(rep.name)
+                    router.note_rerouted(rep)
+                    router.replica_failed(rep)
+                    last_error = (502, {"error": "replica_unreachable",
+                                        "replica": rep.name})
+                    continue
+                router.note_routed(rep)
+                try:
+                    if stream:
+                        self._relay_stream(resp)
+                    else:
+                        self._relay_json(resp)
+                finally:
+                    resp.close()
+                    router.observe_e2e(time.perf_counter() - t0)
+                return
+            router.note_rejected()
+            code, payload = last_error or (
+                503, {"error": "no_replicas",
+                      "detail": "no routable replica"})
+            self._json(code, payload,
+                       headers=(("Retry-After", "1"),))
+
+        def _relay_json(self, resp) -> None:
+            payload = resp.read()
+            self.send_response(resp.status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _relay_stream(self, resp) -> None:
+            """Relay replica ndjson chunk-by-chunk (urllib de-chunks
+            the replica side; we re-chunk toward the client). A
+            replica death mid-stream ends the stream with an error
+            done-frame — tokens already forwarded cannot be unsent,
+            so mid-stream failover is a non-goal; the client retries
+            and lands on a live replica."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                for line in resp:
+                    chunk(line)
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                raise
+            except OSError:
+                # Replica-side failure mid-relay: close the stream
+                # honestly (the flight recorder notes it; the done
+                # frame says error, not length).
+                flightrec.record("router", "stream relay broke")
+                try:
+                    chunk(json.dumps(
+                        {"done": True, "finish_reason": "error",
+                         "error": "replica failed mid-stream"})
+                        .encode() + b"\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
+    return Handler

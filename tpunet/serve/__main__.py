@@ -16,6 +16,44 @@ import signal
 import sys
 
 
+def parse_prefill_buckets(spec, max_seq_len: int):
+    """Validate ``--prefill-buckets``: comma-separated positive ints,
+    none beyond ``--max-seq-len``. A bad entry is a LOUD exit-2 usage
+    error — silently filtering a typo'd bucket used to change the
+    server's compile set (and reject prompts) without a word."""
+    entries = [e.strip() for e in str(spec).split(",") if e.strip()]
+    if not entries:
+        raise _usage(f"--prefill-buckets {spec!r} names no buckets; "
+                     "give at least one padded prompt length, e.g. "
+                     "--prefill-buckets 64,256,1024")
+    buckets = []
+    for raw in entries:
+        try:
+            bucket = int(raw)
+        except ValueError:
+            raise _usage(
+                f"--prefill-buckets entry {raw!r} is not an integer "
+                f"(got {spec!r}; expected comma-separated prompt-"
+                "length buckets like 64,256,1024)")
+        if bucket < 1:
+            raise _usage(f"--prefill-buckets entry {bucket} must be "
+                         ">= 1")
+        if bucket > max_seq_len:
+            raise _usage(
+                f"--prefill-buckets entry {bucket} exceeds "
+                f"--max-seq-len {max_seq_len}: the KV pool cannot "
+                "hold a prompt that long — raise --max-seq-len or "
+                "drop the bucket")
+        buckets.append(bucket)
+    return tuple(buckets)
+
+
+def _usage(msg: str) -> SystemExit:
+    print(f"python -m tpunet.serve: error: {msg}", file=sys.stderr,
+          flush=True)
+    return SystemExit(2)
+
+
 def build_argparser():
     import argparse
 
@@ -74,6 +112,13 @@ def build_argparser():
                    help="replica identity stamped on obs_serve records "
                         "(fleet rollups route by it; default "
                         "serve-<host>-<pid>)")
+    p.add_argument("--aot-cache", default=d.aot_cache, metavar="DIR",
+                   help="AOT warm-start: serialize the compiled decode"
+                        " + prefill executables under DIR on first "
+                        "boot and deserialize them on later boots — "
+                        "replica cold-start drops from compile-bound "
+                        "to seconds (single-device replicas; the "
+                        "persistent compilation cache covers the rest)")
     # LM architecture (must match the trained checkpoint) — mirrors
     # tpunet.infer.generate's flags.
     p.add_argument("--model", choices=("lm", "lm_pp"), default="lm")
@@ -103,6 +148,12 @@ def build_argparser():
 def build_server(args):
     """Construct (but do not start) the ServeServer from parsed args —
     shared by main() and tests."""
+    # Validate the pure-CLI surface BEFORE the jax-importing block
+    # below: a typo'd bucket list should exit 2 in milliseconds, not
+    # after a runtime import.
+    buckets = parse_prefill_buckets(args.prefill_buckets,
+                                    args.max_seq_len)
+
     import dataclasses
 
     from tpunet.config import (CheckpointConfig, DataConfig, MeshConfig,
@@ -114,8 +165,13 @@ def build_server(args):
     from tpunet.serve.frontend import ServeServer
     from tpunet.utils.logging import MetricsLogger
 
-    buckets = tuple(int(b) for b in
-                    str(args.prefill_buckets).split(",") if b)
+    # Shared persistent compilation cache (tpunet/utils/cache.py):
+    # even a replica without --aot-cache warm-starts its compiles from
+    # the per-user cache dir the training/test entry points already
+    # populate (JAX_COMPILATION_CACHE_DIR wins when set).
+    from tpunet.utils.cache import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+
     cfg = ServeConfig(
         host=args.host, port=args.port, slots=args.slots,
         queue_max=args.queue_max, prefill_buckets=buckets,
@@ -126,7 +182,7 @@ def build_server(args):
         classify_window_ms=args.classify_window_ms,
         emit_every_s=args.emit_every_s,
         drain_timeout_s=args.drain_timeout_s,
-        run_id=args.run_id)
+        run_id=args.run_id, aot_cache=args.aot_cache)
     model_cfg = ModelConfig(
         name=args.model, vit_hidden=args.vit_hidden,
         vit_depth=args.vit_depth, vit_heads=args.vit_heads,
@@ -142,7 +198,14 @@ def build_server(args):
     model, variables = load_lm(model_cfg,
                                checkpoint_dir=args.checkpoint_dir,
                                mesh=mesh, train_pipe=args.train_pipe)
-    engine = Engine(model, variables, cfg, mesh=mesh)
+    aot_store = None
+    if cfg.aot_cache and mesh is None:
+        from tpunet.serve.engine import build_aot_store
+        aot_store = build_aot_store(cfg.aot_cache, model_cfg, cfg)
+    engine = Engine(model, variables, cfg, mesh=mesh,
+                    aot_store=aot_store)
+    if engine.aot_status:
+        print(f"aot warm-start: {engine.aot_status}", flush=True)
     registry = engine.registry
 
     metrics_logger = None

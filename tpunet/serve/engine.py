@@ -137,6 +137,24 @@ def build_serve_record(reg, *, queue_depth: int, active_slots: int,
     return record
 
 
+def build_aot_store(directory: str, model_cfg, serve_cfg):
+    """The engine's ``AotProgramStore`` (tpunet/utils/cache.py), keyed
+    by every config field that selects a compiled program: the model
+    architecture plus the pool shape. A replica booted with a different
+    width/depth/slots gets a clean store MISS, never a wrong program
+    (the store key additionally folds in jax version + device kind)."""
+    import dataclasses
+
+    from tpunet.utils.cache import AotProgramStore
+
+    digest = AotProgramStore.digest({
+        "model": dataclasses.asdict(model_cfg),
+        "slots": serve_cfg.slots,
+        "prefill_buckets": list(serve_cfg.prefill_buckets),
+    })
+    return AotProgramStore(directory, digest)
+
+
 class _Slot:
     """Host-side bookkeeping for one KV-cache row."""
 
@@ -160,7 +178,7 @@ class Engine:
     """
 
     def __init__(self, model, variables, cfg, *, registry=None,
-                 mesh=None):
+                 mesh=None, aot_store=None):
         import jax
         import jax.numpy as jnp
 
@@ -208,6 +226,53 @@ class Engine:
         self._step = jax.jit(_masked_step, donate_argnums=(1,))
         self._cache = self._make_cache()
         self._inactive_tok = np.zeros((self.slots, 1), np.int32)
+        # AOT warm-start (tpunet/utils/cache.py AotProgramStore): the
+        # engine's program set is closed — [N, 1] decode + one [N, Lb]
+        # per bucket — so fully-compiled executables deserialize at
+        # boot and the jit path above becomes the fallback for shapes
+        # the store has never seen. Single-device only: a sharded pool
+        # would bake device assignments into the executable.
+        self._aot: dict = {}
+        self.aot_status: dict = {}
+        if aot_store is not None and mesh is None:
+            self._warm_start_aot(aot_store)
+
+    def _warm_start_aot(self, store) -> None:
+        """Load (or compile-and-save) every program the pool can run.
+        Deserialization skips tracing/lowering/XLA entirely — the
+        compile-bound replica cold-start becomes an mmap + relink."""
+        import jax
+
+        def sds(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+        params_s = sds(self.variables["params"])
+        cache_s = sds(self._cache)
+        pos_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
+        act_s = jax.ShapeDtypeStruct((self.slots,), bool)
+        for width in (1,) + self.buckets:
+            tag = f"w{width}"
+            toks_s = jax.ShapeDtypeStruct((self.slots, width), np.int32)
+            program = store.load("masked_step", tag)
+            if program is None:
+                program = self._step.lower(
+                    params_s, cache_s, toks_s, pos_s, act_s).compile()
+                saved = store.save("masked_step", tag, program)
+                self.aot_status[tag] = ("compiled+saved" if saved
+                                        else "compiled")
+            else:
+                self.aot_status[tag] = "loaded"
+            self._aot[width] = program
+
+    def _dispatch_step(self, toks, positions, active):
+        """Run one masked-step program: the AOT executable for this
+        token width when warm-started, the jit fallback otherwise."""
+        program = self._aot.get(toks.shape[1])
+        if program is None:
+            program = self._step
+        return program(self.variables["params"], self._cache, toks,
+                       positions, active)
 
     # -- pool construction ---------------------------------------------
 
@@ -517,9 +582,8 @@ class Engine:
         for _, req in group:
             flightrec.record("req", f"prefill {req.id}")
         with _ring_span("tpunet/serve_prefill"):
-            self._cache, logits = self._step(
-                self.variables["params"], self._cache, toks, positions,
-                active)
+            self._cache, logits = self._dispatch_step(toks, positions,
+                                                      active)
             logits = np.asarray(logits)
         reg = self.registry
         for slot_i, req in group:
@@ -568,9 +632,8 @@ class Engine:
             positions[i] = slot.pos
             active[i] = True
         with _ring_span("tpunet/serve_decode"):
-            self._cache, logits = self._step(
-                self.variables["params"], self._cache, toks, positions,
-                active)
+            self._cache, logits = self._dispatch_step(toks, positions,
+                                                      active)
             logits = np.asarray(logits)
         lap = time.perf_counter() - t0
         reg = self.registry

@@ -35,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from tpunet.serve import httpjson
 from tpunet.serve.engine import Engine, PromptTooLongError
 from tpunet.serve.scheduler import DrainingError, QueueFullError
 
@@ -137,41 +138,37 @@ def _make_handler(server: ServeServer):
 
         # -- helpers ---------------------------------------------------
 
-        def _json(self, code: int, obj: dict) -> None:
-            body = json.dumps(obj).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+        def _json(self, code: int, obj: dict, headers=()) -> None:
+            httpjson.write_json(self, code, obj, headers)
+
+        def _retry_after(self):
+            """503-draining responses carry Retry-After (seconds until
+            this replica is expected back): the router backs the
+            replica off for exactly that long instead of hammering a
+            drain with requests it will reject."""
+            return (("Retry-After",
+                     str(max(1, int(server.engine.cfg.drain_timeout_s)))),)
 
         def _read_body(self) -> dict:
-            n = int(self.headers.get("Content-Length") or 0)
-            if n <= 0:
-                return {}
-            raw = self.rfile.read(n)
-            try:
-                obj = json.loads(raw)
-            except ValueError as e:
-                raise ValueError(f"invalid JSON body: {e}")
-            if not isinstance(obj, dict):
-                raise ValueError("body must be a JSON object")
-            return obj
+            return httpjson.read_json_body(self)
 
         # -- GET -------------------------------------------------------
 
         def do_GET(self):  # noqa: N802 (stdlib handler API)
             if self.path == "/healthz":
                 engine = server.engine
+                run_id = server.registry.identity().get("run_id", "")
                 if engine.error is not None or not engine.healthy:
                     self._json(503, {
-                        "status": "unhealthy",
+                        "status": "unhealthy", "run_id": run_id,
                         "error": engine.error or "engine thread dead"})
                 elif engine.draining:
-                    self._json(503, {"status": "draining"})
+                    self._json(503, {"status": "draining",
+                                     "run_id": run_id},
+                               headers=self._retry_after())
                 else:
                     self._json(200, {
-                        "status": "ok",
+                        "status": "ok", "run_id": run_id,
                         "active_slots": engine.active_slots(),
                         "queue_depth": engine.queue.depth(),
                         "slots": engine.slots})
@@ -239,7 +236,8 @@ def _make_handler(server: ServeServer):
                                  "detail": str(e)})
                 return
             except DrainingError as e:
-                self._json(503, {"error": "draining", "detail": str(e)})
+                self._json(503, {"error": "draining", "detail": str(e)},
+                           headers=self._retry_after())
                 return
             except PromptTooLongError as e:
                 self._json(413, {"error": "prompt_too_long",

@@ -1,4 +1,4 @@
-"""Shared persistent-compile-cache convention.
+"""Shared persistent-compile-cache convention + AOT program store.
 
 ONE home for the cache path and thresholds: tests/conftest.py,
 tests/_mp_worker.py and __graft_entry__.py all call this, so every
@@ -6,12 +6,27 @@ entry point reads and warms the SAME per-user cache directory —
 cross-process warm-cache hits (two multi-controller workers compiling
 identical programs; a dryrun following a test run) depend on the
 convention never diverging between copies.
+
+The AOT store (``AotProgramStore``) is the stronger form the serving
+tier needs: the persistent compilation cache still pays tracing +
+lowering + a cache probe per program at every boot, but a replica's
+program set is CLOSED (one decode step + one program per prefill
+bucket), so the whole ``jax.jit(...).lower().compile()`` result can be
+serialized once (``jax.experimental.serialize_executable``) and
+deserialized at boot — no tracing, no lowering, no XLA invocation.
+That is what turns replica cold-start from compile-bound minutes into
+seconds and makes the router tier's scale-up decisions actionable
+(docs/serving.md "AOT warm-start"). Entries are keyed by a caller-
+supplied config digest + program shape + jax version + backend, so a
+changed model config or runtime can never load a stale executable.
 """
 
 from __future__ import annotations
 
 import getpass
+import hashlib
 import os
+import pickle
 import tempfile
 
 
@@ -44,3 +59,82 @@ def enable_persistent_compile_cache(directory: str | None = None) -> None:
         or cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+class AotProgramStore:
+    """Serialize/deserialize fully-compiled jax executables on disk.
+
+    One store = one directory of ``<key>.aotx`` files, each a pickled
+    ``(serialized_executable, in_tree, out_tree)`` triple from
+    ``jax.experimental.serialize_executable.serialize``. The key folds
+    in the caller's config digest (model architecture + pool shape),
+    the program name and shape tag, the jax version, and the backend's
+    device kind — any mismatch is a clean MISS, never a wrong program.
+
+    ``load`` returns the loaded executable or None; ``save`` is
+    best-effort (a read-only disk degrades to the persistent
+    compilation cache, not to a crash). Both are torn-write-safe
+    (tmp + rename) like every other artifact writer in the repo.
+    """
+
+    SUFFIX = ".aotx"
+
+    def __init__(self, directory: str, config_digest: str):
+        self.directory = directory
+        self.config_digest = config_digest
+
+    @staticmethod
+    def digest(parts: object) -> str:
+        """Stable 16-hex digest of a JSON-able description (the model/
+        pool config fields that select a program)."""
+        import json
+        blob = json.dumps(parts, sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def _path(self, name: str, shape_tag: str) -> str:
+        import jax
+        runtime = self.digest({
+            "jax": jax.__version__,
+            "device_kind": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+        })
+        key = f"{name}-{shape_tag}-{self.config_digest}-{runtime}"
+        return os.path.join(self.directory, key + self.SUFFIX)
+
+    def load(self, name: str, shape_tag: str):
+        """The deserialized executable, or None on miss/corruption
+        (a corrupt entry is removed so the next save rewrites it)."""
+        path = self._path(name, shape_tag)
+        if not os.path.exists(path):
+            return None
+        from jax.experimental import serialize_executable
+        try:
+            with open(path, "rb") as f:
+                blob, in_tree, out_tree = pickle.load(f)
+            return serialize_executable.deserialize_and_load(
+                blob, in_tree, out_tree)
+        except Exception:  # noqa: BLE001 — a stale/corrupt entry must
+            # degrade to a recompile, never kill the boot.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+
+    def save(self, name: str, shape_tag: str, compiled) -> bool:
+        """Serialize one compiled executable; best-effort (False on
+        any failure — the persistent compilation cache still covers
+        the next boot)."""
+        from jax.experimental import serialize_executable
+        try:
+            blob, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            os.makedirs(self.directory, exist_ok=True)
+            path = self._path(name, shape_tag)
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump((blob, in_tree, out_tree), f)
+            os.replace(tmp, path)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
