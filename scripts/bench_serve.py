@@ -269,6 +269,107 @@ def run_cold_start_bench(args) -> dict:
     return record
 
 
+def _lever_overrides(args) -> dict:
+    """ServeConfig overrides from the paged-KV / sampling lever flags
+    (None = keep the config default, so the default bench measures the
+    shipping configuration)."""
+    over = {"kv_pages": args.kv_pages,
+            "kv_page_tokens": args.kv_page_tokens,
+            "kv_dtype": args.kv_dtype}
+    if args.paged_kv is not None:
+        over["paged_kv"] = args.paged_kv
+    if args.device_sampling is not None:
+        over["device_sampling"] = args.device_sampling
+    return over
+
+
+def run_slots_sweep(args, model, variables) -> dict:
+    """Fixed-KV-pool-bytes capacity sweep (the paging acceptance
+    measurement): take the DENSE pool's byte footprint at
+    ``--slots`` slots as the budget, size a paged (+ optionally int8)
+    pool to AT MOST those bytes, then drive ascending offered
+    concurrency through it and report tokens/s + the admitted-slot
+    high-water mark per level. ``slot_capacity`` is the analytic
+    concurrent-request capacity at the sweep workload's length
+    (prompt + new tokens); the engine's slot count is capped at
+    4x the dense baseline so the jitted batch stays benchable."""
+    from tpunet.config import ServeConfig
+    from tpunet.serve import Engine
+
+    bucket = 1 << max(4, (args.prompt_len - 1).bit_length())
+    bucket = min(bucket, args.max_seq_len)
+    dense_cfg = ServeConfig(slots=args.slots, queue_max=1024,
+                            prefill_buckets=(bucket,), emit_every_s=0.0,
+                            paged_kv=False, device_sampling=False)
+    dense_engine = Engine(model, variables, dense_cfg)
+    pool_budget = dense_engine.kv_pool_bytes()
+    dense_bytes_per_slot = pool_budget / args.slots
+    del dense_engine
+
+    # Probe the paged per-page byte cost (pool bytes are linear in
+    # pages+garbage), then size the pool to the dense budget.
+    pt = args.kv_page_tokens
+    kv_dtype = args.kv_dtype
+    probe = Engine(model, variables, ServeConfig(
+        slots=1, queue_max=1, prefill_buckets=(bucket,),
+        emit_every_s=0.0, kv_pages=1, kv_page_tokens=pt,
+        kv_dtype=kv_dtype))
+    bytes_per_page = probe.kv_pool_bytes() / 2     # 1 usable + garbage
+    del probe
+    usable = max(1, int(pool_budget // bytes_per_page) - 1)
+    req_tokens = args.prompt_len + args.new_tokens
+    pages_per_req = -(-req_tokens // pt)
+    slot_capacity = max(1, usable // pages_per_req)
+    sweep_slots = min(slot_capacity, 4 * args.slots)
+    sampling = (args.device_sampling if args.device_sampling is not None
+                else ServeConfig.device_sampling)
+    cfg = ServeConfig(slots=sweep_slots, queue_max=4096,
+                      prefill_buckets=(bucket,), emit_every_s=0.0,
+                      kv_pages=usable, kv_page_tokens=pt,
+                      kv_dtype=kv_dtype, device_sampling=sampling)
+    engine = Engine(model, variables, cfg).start()
+    levels = sorted({max(1, sweep_slots // 4), sweep_slots // 2,
+                     sweep_slots} - {0})
+    rows = []
+    try:
+        engine.submit(np.zeros(args.prompt_len, np.int32),
+                      max_new_tokens=2).result(timeout=600)
+        for c in levels:
+            engine.peak_active_slots = 0
+            r = run_level(engine, c, prompt_len=args.prompt_len,
+                          new_tokens=args.new_tokens,
+                          requests_per_client=args.requests_per_client,
+                          vocab=args.vocab_size)
+            r["admitted_slots_peak"] = engine.peak_active_slots
+            rows.append(r)
+        paged_pool = engine.kv_pool_bytes()
+        bytes_per_token = engine.kv_bytes_per_token()
+    finally:
+        engine.stop()
+    import jax
+    peak = max((r["admitted_slots_peak"] for r in rows), default=0)
+    return {
+        "mode": "slots_sweep",
+        "device": jax.devices()[0].device_kind,
+        "device_sampling": sampling,
+        "kv_dtype": kv_dtype,
+        "kv_page_tokens": pt,
+        "prompt_len": args.prompt_len,
+        "new_tokens": args.new_tokens,
+        "fixed_pool_bytes": int(pool_budget),
+        "dense_slots": args.slots,
+        "dense_kv_hbm_bytes_per_slot": round(dense_bytes_per_slot, 1),
+        "paged_pool_bytes": int(paged_pool),
+        "paged_kv_pages": usable,
+        "kv_bytes_per_token": round(bytes_per_token, 2),
+        "slot_capacity": slot_capacity,
+        "slot_capacity_vs_dense": round(slot_capacity / args.slots, 2),
+        "admitted_slots_peak": peak,
+        "admitted_vs_dense": round(peak / args.slots, 2),
+        "levels": rows,
+    }
+
+
 def _get_json(url, timeout=10):
     import urllib.request
     with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -466,6 +567,29 @@ def main() -> None:
                          "(drain = SIGTERM graceful; dropped "
                          "requests must be 0 for drain, bounded for "
                          "sigkill)")
+    ap.add_argument("--slots-sweep", action="store_true",
+                    help="fixed-KV-pool-bytes capacity sweep: size a "
+                         "paged pool to the DENSE pool's bytes, then "
+                         "report tokens/s and admitted-slot count vs "
+                         "offered concurrency — the concurrent-slot "
+                         "multiplier paging buys at constant HBM")
+    ap.add_argument("--paged-kv", default=None,
+                    action=argparse.BooleanOptionalAction,
+                    help="engine paged-KV lever for A/Bs (default: "
+                         "the ServeConfig default, ON)")
+    ap.add_argument("--kv-pages", type=int, default=0,
+                    help="usable KV pages (0 = dense-equivalent "
+                         "capacity)")
+    ap.add_argument("--kv-page-tokens", type=int, default=16,
+                    help="tokens per KV page")
+    ap.add_argument("--kv-dtype", default="auto",
+                    choices=("auto", "bf16", "int8"),
+                    help="KV page payload dtype (int8 = quantized "
+                         "pages, per-row scale)")
+    ap.add_argument("--device-sampling", default=None,
+                    action=argparse.BooleanOptionalAction,
+                    help="fused on-device sampling lever for A/Bs "
+                         "(default: the ServeConfig default, ON)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="LM best checkpoint (default: random tiny "
                          "weights — throughput shape, not quality)")
@@ -561,6 +685,24 @@ def main() -> None:
         variables = init_variables(model, jax.random.PRNGKey(0),
                                    seq_len=16)
 
+    if args.slots_sweep:
+        if args.paged_kv is False:
+            # The sweep IS the paged-capacity measurement; silently
+            # benchmarking the paged pool under a dense flag would
+            # mislabel the record — refuse loudly.
+            print("--no-paged-kv is incompatible with --slots-sweep "
+                  "(the sweep measures paged capacity against the "
+                  "dense byte budget); drop one of the flags",
+                  file=sys.stderr)
+            sys.exit(2)
+        out = run_slots_sweep(args, model, variables)
+        print(json.dumps(out, indent=1))
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        return
+
     # Sequential baseline: the pre-serve shape — one request at a time
     # through models.lm.generate (warmed compile).
     p = np.zeros((1, args.prompt_len), np.int32)
@@ -575,7 +717,7 @@ def main() -> None:
     bucket = 1 << max(4, (args.prompt_len - 1).bit_length())
     cfg = ServeConfig(slots=args.slots, queue_max=max(64, 4 * args.slots),
                       prefill_buckets=(min(bucket, args.max_seq_len),),
-                      emit_every_s=0.0)
+                      emit_every_s=0.0, **_lever_overrides(args))
     engine = Engine(model, variables, cfg).start()
     try:
         # warm prefill + decode programs outside the measurement
@@ -595,6 +737,15 @@ def main() -> None:
         "slots": args.slots,
         "prompt_len": args.prompt_len,
         "new_tokens": args.new_tokens,
+        "paged_kv": engine._paged_kv is not None,
+        "kv_dtype": cfg.kv_dtype,
+        "device_sampling": engine.device_sampling,
+        # KV capacity telemetry: pool bytes pinned per slot and per
+        # cacheable token (the serve_budget.json kv_bytes_per_token
+        # ceiling gates the latter against silent pool bloat).
+        "kv_hbm_bytes_per_slot": round(
+            engine.kv_pool_bytes() / engine.slots, 1),
+        "kv_bytes_per_token": round(engine.kv_bytes_per_token(), 2),
         "sequential_tokens_per_s": round(seq_tps, 1),
         "levels": results,
         "speedup_vs_sequential": {

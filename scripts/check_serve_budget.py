@@ -125,11 +125,13 @@ def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
                       "nothing to enforce"]
     if record.get("mode") == "cold_start":
         return check_cold_start(record, key, entry, tol)
+    ok_kv, kv_msgs = check_kv_bytes(record, key, entry, tol)
     budgeted = entry.get("tokens_per_s_per_slot")
     measured = tokens_per_s_per_slot(record)
     if budgeted is None:
-        return True, [f"{key}: budget entry has no "
-                      "tokens_per_s_per_slot; nothing to enforce"]
+        return ok_kv, kv_msgs + [f"{key}: budget entry has no "
+                                 "tokens_per_s_per_slot; nothing to "
+                                 "enforce"]
     if measured is None:
         levels = record.get("levels") or []
         total = sum(lv.get("total_tokens") or 0 for lv in levels)
@@ -144,15 +146,39 @@ def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
                            f"0 tokens served "
                            f"({levels[0]['errors'][:1]}...); serving "
                            "is broken [REGRESSION]"]
-        return True, [f"{key}: no usable tokens/s measurement in record "
-                      f"(floor {budgeted:.0f}); skipping"]
+        return ok_kv, kv_msgs + [
+            f"{key}: no usable tokens/s measurement in record "
+            f"(floor {budgeted:.0f}); skipping"]
     floor = budgeted * (1.0 - tol)
     ok = measured >= floor
     verdict = "OK" if ok else "REGRESSION"
-    return ok, [
+    return ok and ok_kv, kv_msgs + [
         f"{key}: tokens_per_s_per_slot measured {measured:.1f} vs "
         f"floor {budgeted:.1f} (-{100 * tol:.0f}% tolerance -> "
         f"limit {floor:.1f}) [{verdict}]"]
+
+
+def check_kv_bytes(record: Dict, key: str, entry: Dict,
+                   tol: float) -> Tuple[bool, List[str]]:
+    """KV-capacity ceiling: ``kv_bytes_per_token`` (pool bytes pinned
+    per cacheable token under the DEFAULT bench invocation) is gated
+    from ABOVE — page-table metadata creep or a broken pool auto-size
+    silently taxes every slot's HBM, and no throughput floor would
+    notice on a tiny CPU model. Records that predate the field skip
+    with a note."""
+    ceiling = entry.get("kv_bytes_per_token")
+    measured = record.get("kv_bytes_per_token")
+    if ceiling is None:
+        return True, []
+    if measured is None:
+        return True, [f"{key}: record carries no kv_bytes_per_token "
+                      f"(ceiling {ceiling:.0f}); skipping"]
+    limit = ceiling * (1.0 + tol)
+    ok = measured <= limit
+    return ok, [
+        f"{key}: kv_bytes_per_token measured {measured:.1f} vs "
+        f"ceiling {ceiling:.1f} (+{100 * tol:.0f}% tolerance -> "
+        f"limit {limit:.1f}) [{'OK' if ok else 'REGRESSION'}]"]
 
 
 def main(argv=None) -> int:

@@ -115,11 +115,54 @@ def test_checked_in_serve_budget_file_is_valid():
     assert budget["tolerance_pct"] > 0
     cpu = budget["budgets"]["cpu"]
     assert cpu["tokens_per_s_per_slot"] > 0
+    assert cpu["kv_bytes_per_token"] > 0
     # The floor must be enforceable against a record shaped like
     # bench_serve's output.
     ok, msgs = check_record(
         _record(tpss=cpu["tokens_per_s_per_slot"]), budget)
     assert ok, msgs
+
+
+def _kv_budget(floor=100.0, ceiling=1024.0, tol=50):
+    return {"tolerance_pct": tol,
+            "budgets": {"cpu": {"tokens_per_s_per_slot": floor,
+                                "kv_bytes_per_token": ceiling}}}
+
+
+def test_kv_bytes_within_ceiling_passes():
+    rec = _record(tpss=200.0)
+    rec["kv_bytes_per_token"] = 1024.0
+    ok, msgs = check_record(rec, _kv_budget())
+    assert ok and any("kv_bytes_per_token" in m and "OK" in m
+                      for m in msgs)
+
+
+def test_kv_bytes_over_ceiling_fails_even_with_fast_tokens():
+    """The capacity ceiling is independent of the throughput floor: a
+    pool that silently doubled its per-token bytes fails the gate even
+    while tokens/s still clears the floor (on a tiny CPU model the
+    bloat costs no wall clock — that is exactly why it needs its own
+    gate)."""
+    rec = _record(tpss=1e6)
+    rec["kv_bytes_per_token"] = 1024.0 * 1.6   # past +50% tolerance
+    ok, msgs = check_record(rec, _kv_budget())
+    assert not ok
+    assert any("kv_bytes_per_token" in m and "REGRESSION" in m
+               for m in msgs)
+    assert any("tokens_per_s_per_slot" in m and "OK" in m
+               for m in msgs)
+
+
+def test_kv_bytes_missing_from_old_record_skips_with_note():
+    ok, msgs = check_record(_record(tpss=200.0), _kv_budget())
+    assert ok and any("no kv_bytes_per_token" in m for m in msgs)
+
+
+def test_kv_ceiling_absent_from_budget_is_silent():
+    rec = _record(tpss=200.0)
+    rec["kv_bytes_per_token"] = 9e9
+    ok, msgs = check_record(rec, _budget(100.0))
+    assert ok and not any("kv_bytes" in m for m in msgs)
 
 
 def test_budget_cli_parses_artifact(tmp_path, capsys):

@@ -140,15 +140,9 @@ def test_http_end_to_end(tmp_path):
     assert final["queue_depth"] == 0 and final["active_slots"] == 0
 
 
-def test_http_concurrent_parity_eight_requests():
-    """The ISSUE acceptance check: 8 concurrent POSTs through 2 slots
-    return token-identical output to solo greedy decode."""
-    from tpunet.models.lm import generate
-
-    srv = make_server(queue_max=8)
+def _eight_way_outputs(srv):
+    """8 concurrent POSTs through 2 slots; returns the token lists."""
     base = f"http://127.0.0.1:{srv.port}"
-    model = srv.engine.model
-    variables = srv.engine.variables
     rng = np.random.default_rng(1)
     prompts = [rng.integers(0, 256, size=int(n)).astype(int).tolist()
                for n in rng.integers(2, 10, size=8)]
@@ -158,21 +152,79 @@ def test_http_concurrent_parity_eight_requests():
         results[i] = post(base, "/v1/generate",
                           {"tokens": prompts[i], "max_new_tokens": 6})
 
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    outs = []
+    for res in results:
+        assert res is not None, "worker timed out"
+        code, out = res
+        assert code == 200, out
+        outs.append(out["tokens"])
+    return prompts, outs
+
+
+def test_http_concurrent_parity_eight_requests():
+    """The ISSUE acceptance check: 8 concurrent POSTs through 2 slots
+    (paged KV + device sampling, the default path) return
+    token-identical output to solo greedy decode."""
+    from tpunet.models.lm import generate
+
+    srv = make_server(queue_max=8)
+    model = srv.engine.model
+    variables = srv.engine.variables
     try:
-        threads = [threading.Thread(target=worker, args=(i,))
-                   for i in range(8)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=300)
-        for p, res in zip(prompts, results):
-            assert res is not None, "worker timed out"
-            code, out = res
-            assert code == 200, out
+        assert srv.engine._paged_kv is not None  # default = paged
+        prompts, outs = _eight_way_outputs(srv)
+        for p, out in zip(prompts, outs):
             solo = np.asarray(generate(
                 model, variables,
                 np.asarray(p, np.int32)[None], n_new=6))[0, len(p):]
-            assert out["tokens"] == solo.tolist()
+            assert out == solo.tolist()
+    finally:
+        srv.drain(timeout=10.0)
+
+
+def test_http_paged_vs_dense_parity_eight_requests():
+    """Paged-vs-dense parity through HTTP at 8-way concurrency: the
+    dense fallback server (--no-paged-kv --no-device-sampling, the
+    PR-11 path) answers the same 8 concurrent requests with the same
+    tokens the paged+device-sampled default produces."""
+    srv_paged = make_server(queue_max=8)
+    srv_dense = make_server(queue_max=8, paged_kv=False,
+                            device_sampling=False)
+    try:
+        _, outs_paged = _eight_way_outputs(srv_paged)
+        _, outs_dense = _eight_way_outputs(srv_dense)
+        assert outs_paged == outs_dense
+    finally:
+        srv_paged.drain(timeout=10.0)
+        srv_dense.drain(timeout=10.0)
+
+
+def test_http_response_reports_effective_budget():
+    """The clamp satellite over the wire: a budget clamped at
+    admission (operator cap / KV length) surfaces as max_new_tokens +
+    requested_max_new_tokens in the response metadata instead of a
+    silently short token list."""
+    srv = make_server(max_new_tokens_cap=4)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        code, out = post(base, "/v1/generate",
+                         {"prompt": "hi", "max_new_tokens": 50})
+        assert code == 200
+        assert len(out["tokens"]) == 4
+        assert out["max_new_tokens"] == 4
+        assert out["requested_max_new_tokens"] == 50
+        # an unclamped request reports its effective budget only
+        code, out2 = post(base, "/v1/generate",
+                          {"prompt": "hi", "max_new_tokens": 3})
+        assert code == 200
+        assert out2["max_new_tokens"] == 3
+        assert "requested_max_new_tokens" not in out2
     finally:
         srv.drain(timeout=10.0)
 

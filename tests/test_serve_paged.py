@@ -1,0 +1,457 @@
+"""Paged KV cache + device-side batched sampling + int8 KV (PR 12).
+
+Covers the serve engine's rebuilt memory and sampling hot paths on a
+tiny CPU LM: device-vs-host sampler parity (greedy bit-identical;
+seeded stochastic draws stay inside filter_logits' support and are
+deterministic per (seed, step)), page-recycling/fragmentation stress
+(churn until every page has been reused; no stale-KV bleed across slot
+reuse), pool-exhaustion preemption resuming token-identically, the
+int8 eval-parity gate, the effective-budget satellite, and AOT
+cold-start of the paged+fused program set.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpunet.config import ModelConfig, ServeConfig
+from tpunet.models import create_model, init_variables
+from tpunet.models.lm import filter_logits, generate
+from tpunet.serve import Engine, GenerateRequest, PromptTooLongError
+
+TINY = ModelConfig(name="lm", vit_hidden=32, vit_depth=2, vit_heads=2,
+                   dropout_rate=0.0, dtype="float32", vocab_size=31,
+                   max_seq_len=48)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    model = create_model(TINY)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+    return model, variables
+
+
+def make_engine(tiny_lm, **cfg_kw):
+    model, variables = tiny_lm
+    cfg_kw.setdefault("slots", 4)
+    cfg_kw.setdefault("queue_max", 16)
+    cfg_kw.setdefault("prefill_buckets", (8, 16))
+    cfg_kw.setdefault("default_max_new_tokens", 6)
+    cfg_kw.setdefault("emit_every_s", 0.0)
+    return Engine(model, variables, ServeConfig(**cfg_kw))
+
+
+def prompts(n, rng_seed=0, lo=2, hi=9):
+    rng = np.random.default_rng(rng_seed)
+    return [rng.integers(0, TINY.vocab_size,
+                         size=int(rng.integers(lo, hi))).astype(np.int32)
+            for _ in range(n)]
+
+
+def solo_greedy(tiny_lm, prompt, n_new):
+    model, variables = tiny_lm
+    out = generate(model, variables, np.asarray(prompt)[None],
+                   n_new=n_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# device-vs-host sampler parity
+# ---------------------------------------------------------------------------
+
+def test_batched_sample_greedy_is_bitwise_argmax():
+    """Greedy rows (temperature <= 0) of the device sampler must equal
+    the host sampler's np.argmax on the same float32 logits — the
+    invariant that keeps greedy serve output token-identical to solo
+    generate."""
+    from tpunet.serve.sampling import batched_sample
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(16, 64)).astype(np.float32) * 3
+    n = logits.shape[0]
+    toks = np.asarray(batched_sample(
+        jnp.asarray(logits), np.zeros(n, np.float32),
+        np.zeros(n, np.int32), np.zeros(n, np.float32),
+        np.arange(n, dtype=np.int32), np.zeros(n, np.int32)))
+    np.testing.assert_array_equal(toks, np.argmax(logits, axis=-1))
+
+
+def test_batched_sample_support_matches_filter_logits():
+    """Per-row stochastic draws over many steps must stay inside the
+    support filter_logits admits for that row's (temperature, top_k,
+    top_p) — the device path may not sample tokens the reference
+    warper would have filtered out. Rows carry DIFFERENT parameters in
+    one batch (the whole point of the per-row sampler)."""
+    from tpunet.serve.sampling import batched_sample
+    rng = np.random.default_rng(3)
+    logits = rng.normal(size=(3, 24)).astype(np.float32) * 2
+    params = [(0.8, 3, 0.0), (1.2, 0, 0.7), (0.6, 5, 0.8)]
+    temp = np.asarray([p[0] for p in params], np.float32)
+    top_k = np.asarray([p[1] for p in params], np.int32)
+    top_p = np.asarray([p[2] for p in params], np.float32)
+    allowed = []
+    for row, (t, k, p) in zip(logits, params):
+        ref = np.asarray(filter_logits(jnp.asarray(row)[None] / t,
+                                       top_k=k, top_p=p))[0]
+        allowed.append(set(np.nonzero(np.isfinite(ref))[0].tolist()))
+    seeds = np.asarray([7, 8, 9], np.int32)
+    seen = [set(), set(), set()]
+    for step in range(60):
+        toks = np.asarray(batched_sample(
+            jnp.asarray(logits), temp, top_k, top_p, seeds,
+            np.full(3, step, np.int32)))
+        for i, t in enumerate(toks):
+            seen[i].add(int(t))
+    for i in range(3):
+        assert seen[i] <= allowed[i], (params[i], seen[i] - allowed[i])
+        # every filter keeps the argmax reachable
+        assert int(np.argmax(logits[i])) in allowed[i]
+
+
+def test_batched_sample_deterministic_per_seed_and_step():
+    """The counter-based key fold: same (seed, step) reproduces the
+    same token, a different seed or step (almost surely) moves at
+    least one row — and rows are independent (changing row 0's seed
+    never changes row 1's draw)."""
+    from tpunet.serve.sampling import batched_sample
+    rng = np.random.default_rng(5)
+    logits = jnp.asarray(rng.normal(size=(4, 32)).astype(np.float32))
+    temp = np.full(4, 1.0, np.float32)
+    zk = np.zeros(4, np.int32)
+    zp = np.zeros(4, np.float32)
+    seeds = np.asarray([1, 2, 3, 4], np.int32)
+    step0 = np.zeros(4, np.int32)
+    a = np.asarray(batched_sample(logits, temp, zk, zp, seeds, step0))
+    b = np.asarray(batched_sample(logits, temp, zk, zp, seeds, step0))
+    np.testing.assert_array_equal(a, b)
+    seeds2 = seeds.copy()
+    seeds2[0] = 99
+    c = np.asarray(batched_sample(logits, temp, zk, zp, seeds2, step0))
+    np.testing.assert_array_equal(a[1:], c[1:])  # row independence
+    draws = {tuple(np.asarray(batched_sample(
+        logits, temp, zk, zp, seeds,
+        np.full(4, s, np.int32))).tolist()) for s in range(12)}
+    assert len(draws) > 1  # steps actually advance the stream
+
+
+def test_seed_validated_at_admission():
+    """A bad seed is a client error at admission (the frontend maps
+    ValueError to HTTP 400), never an engine-thread death on the host
+    sampler (numpy rejects negatives) or a silent int32 stream
+    collision on the device path (seeds past bit 31)."""
+    with pytest.raises(ValueError, match="seed"):
+        GenerateRequest(np.arange(1, 4), max_new_tokens=2, seed=-3)
+    with pytest.raises(ValueError, match="seed"):
+        GenerateRequest(np.arange(1, 4), max_new_tokens=2, seed=2 ** 31)
+    GenerateRequest(np.arange(1, 4), max_new_tokens=2, seed=2 ** 31 - 1)
+
+
+def test_engine_host_sampler_fallback_matches_device_greedy(tiny_lm):
+    """--no-device-sampling keeps the host sampler as the live parity
+    reference: greedy output through both engine paths is identical
+    (and equals solo generate)."""
+    ps = prompts(4, rng_seed=11)
+    outs = {}
+    for label, dev in (("device", True), ("host", False)):
+        eng = make_engine(tiny_lm, device_sampling=dev).start()
+        try:
+            reqs = [eng.submit(p, max_new_tokens=5) for p in ps]
+            outs[label] = [r.result(timeout=120) for r in reqs]
+        finally:
+            eng.stop()
+    assert outs["device"] == outs["host"]
+    for p, o in zip(ps, outs["device"]):
+        assert o == solo_greedy(tiny_lm, p, 5)
+
+
+# ---------------------------------------------------------------------------
+# paged pool: recycling / fragmentation / preemption
+# ---------------------------------------------------------------------------
+
+def test_page_recycling_stress_no_stale_kv_bleed(tiny_lm):
+    """Churn admissions through a small pool until EVERY usable page
+    has been allocated at least once and the allocation count proves
+    reuse; every request's greedy output must still match solo decode
+    — a recycled page leaking its previous occupant's K/V would
+    diverge immediately."""
+    eng = make_engine(tiny_lm, slots=2, kv_pages=8,
+                      kv_page_tokens=4).start()
+    try:
+        wave = 0
+        # Requests of 5-8 prompt + 8 new tokens span 4 pages each, so
+        # two co-residents demand the WHOLE 8-page pool; LIFO
+        # recycling alone would otherwise keep cold pages cold.
+        while wave < 12 and (len(eng._kv_pages_touched)
+                             < eng.kv_pages_usable or wave < 4):
+            ps = prompts(4, rng_seed=100 + wave, lo=5, hi=9)
+            reqs = [eng.submit(p, max_new_tokens=8) for p in ps]
+            for p, r in zip(ps, reqs):
+                assert r.result(timeout=120) == \
+                    solo_greedy(tiny_lm, p, 8), f"wave {wave} diverged"
+            wave += 1
+        assert eng._kv_pages_touched == set(
+            range(1, eng.kv_pages_usable + 1)), "pages never all used"
+        snap = eng.registry.snapshot()
+        assert snap["serve_kv_page_allocs_total"] > eng.kv_pages_usable, \
+            "allocation count proves no page was ever recycled"
+        assert len(eng._free_pages) == eng.kv_pages_usable
+        assert snap["serve_kv_pages_used"] == 0
+    finally:
+        eng.stop()
+
+
+def test_pool_exhaustion_preempts_and_resumes_token_identically(tiny_lm):
+    """5 usable pages x 4 tokens cannot hold two full-length
+    co-residents: the engine must preempt the youngest blocked slot
+    back to the queue and resume it by re-prefilling prompt+generated
+    — every request still finishes with exactly the solo-greedy
+    tokens."""
+    eng = make_engine(tiny_lm, slots=2, kv_pages=5, kv_page_tokens=4,
+                      default_max_new_tokens=12).start()
+    try:
+        ps = prompts(4, rng_seed=1, lo=6, hi=7)
+        reqs = [eng.submit(p, max_new_tokens=12) for p in ps]
+        for p, r in zip(ps, reqs):
+            assert r.result(timeout=120) == solo_greedy(tiny_lm, p, 12)
+            assert r.finish_reason == "length"
+        snap = eng.registry.snapshot()
+        assert snap["serve_kv_preemptions_total"] >= 1
+        assert sum(r.preemptions for r in reqs) >= 1
+    finally:
+        eng.stop()
+
+
+def test_preempt_victim_prefers_resumable_slots(tiny_lm):
+    """Victim selection under pool exhaustion: a slot whose
+    prompt+generated has outgrown the largest prefill bucket cannot be
+    re-prefilled, so preempting it would error a healthy in-flight
+    request — the YOUNGEST RESUMABLE slot must be chosen instead, and
+    an unresumable one only when there is no alternative."""
+    from tpunet.serve.engine import _Slot
+
+    eng = make_engine(tiny_lm)          # buckets (8, 16)
+    old_long = _Slot(GenerateRequest(np.ones(6, np.int32),
+                                     max_new_tokens=30),
+                     pos=20, next_token=1, seq=1)
+    old_long.req.tokens.extend([1] * 14)     # resume size 20 > 16
+    young_short = _Slot(GenerateRequest(np.ones(4, np.int32),
+                                        max_new_tokens=30),
+                        pos=8, next_token=1, seq=2)
+    young_short.req.tokens.extend([1] * 4)   # resume size 8 <= 16
+    # youngest overall is resumable -> picked (slot index 1)
+    assert eng._choose_preempt_victim(
+        [(0, old_long), (1, young_short)]) == 1
+    # youngest overall unresumable, older resumable exists -> the
+    # OLDER resumable one is picked, never the unresumable youngest
+    young_long = _Slot(GenerateRequest(np.ones(6, np.int32),
+                                       max_new_tokens=30),
+                       pos=20, next_token=1, seq=3)
+    young_long.req.tokens.extend([1] * 14)
+    assert eng._choose_preempt_victim(
+        [(1, young_short), (2, young_long)]) == 1
+    # every blocked slot unresumable -> youngest fails (unavoidable)
+    assert eng._choose_preempt_victim(
+        [(0, old_long), (2, young_long)]) == 2
+
+
+def test_request_that_cannot_fit_pool_rejected_up_front(tiny_lm):
+    """Completability guard: a request whose full length exceeds the
+    whole pool would preempt itself forever — submit rejects it."""
+    eng = make_engine(tiny_lm, slots=2, kv_pages=5, kv_page_tokens=4)
+    with pytest.raises(PromptTooLongError):
+        eng.submit(np.ones(8, np.int32), max_new_tokens=40)
+    assert eng.registry.snapshot()["serve_requests_rejected"] == 1
+
+
+def test_paged_vs_dense_engine_outputs_identical(tiny_lm):
+    """The dense fallback (--no-paged-kv) and the paged default are
+    the same math: identical greedy tokens across a mid-flight
+    admission pattern."""
+    import time
+    outs = {}
+    for label, paged in (("paged", True), ("dense", False)):
+        eng = make_engine(tiny_lm, slots=2, paged_kv=paged).start()
+        try:
+            ps = prompts(6, rng_seed=42)
+            reqs = []
+            for i, p in enumerate(ps):
+                reqs.append(eng.submit(p, max_new_tokens=5))
+                if i % 2 == 1:
+                    time.sleep(0.01)
+            outs[label] = [r.result(timeout=120) for r in reqs]
+        finally:
+            eng.stop()
+    assert outs["paged"] == outs["dense"]
+
+
+# ---------------------------------------------------------------------------
+# int8 KV parity gate
+# ---------------------------------------------------------------------------
+
+def test_int8_kv_eval_parity_gate(tiny_lm):
+    """The eval-parity gate for --kv-dtype int8: greedy decode through
+    quantized pages must be token-identical to the float32 path on the
+    tiny model across a prompt spread. (Quantization error exists —
+    this gate is what keeps it below argmax-flipping size; a model
+    where it trips must not ship int8 KV.)"""
+    eng = make_engine(tiny_lm, kv_dtype="int8").start()
+    try:
+        for seed in range(6):
+            p = prompts(1, rng_seed=seed)[0]
+            out = eng.submit(p, max_new_tokens=6).result(timeout=120)
+            assert out == solo_greedy(tiny_lm, p, 6), \
+                f"int8 KV diverged on seed {seed}"
+    finally:
+        eng.stop()
+
+
+def test_int8_kv_halves_bf16_page_cost(tiny_lm):
+    """The capacity claim, measured: int8 pages (payload + scale
+    sidecar) cost less than half the float32 pages and at most ~60%
+    of bf16 pages for this head size."""
+    sizes = {}
+    for dtype in ("auto", "bf16", "int8"):
+        eng = make_engine(tiny_lm, kv_dtype=dtype)
+        sizes[dtype] = eng.kv_bytes_per_token()
+    assert sizes["int8"] < sizes["auto"] / 2
+    assert sizes["int8"] < sizes["bf16"] * 0.75
+    assert sizes["bf16"] == pytest.approx(sizes["auto"] / 2)
+
+
+def test_int8_requires_paged_kv(tiny_lm):
+    with pytest.raises(ValueError):
+        make_engine(tiny_lm, paged_kv=False, kv_dtype="int8")
+
+
+# ---------------------------------------------------------------------------
+# effective-budget satellite
+# ---------------------------------------------------------------------------
+
+def test_submit_records_requested_and_effective_budget(tiny_lm):
+    """The admission clamp is explicit now: requested_max_new_tokens
+    keeps the client's ask, max_new_tokens becomes the effective
+    budget (operator cap, then KV-length clamp)."""
+    eng = make_engine(tiny_lm, prefill_buckets=(48,),
+                      max_new_tokens_cap=2048).start()
+    try:
+        req = eng.submit(np.ones(40, np.int32), max_new_tokens=100)
+        out = req.result(timeout=60)
+        assert req.requested_max_new_tokens == 100
+        assert req.max_new_tokens == 8          # 48 - 40
+        assert len(out) == 8
+        # the cap clamp is recorded the same way
+        eng2 = make_engine(tiny_lm, max_new_tokens_cap=3)
+        r2 = eng2.submit(np.ones(4, np.int32), max_new_tokens=50)
+        assert r2.requested_max_new_tokens == 50
+        assert r2.max_new_tokens == 3
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# obs: kv gauges + record fields
+# ---------------------------------------------------------------------------
+
+def test_kv_gauges_and_serve_record_fields(tiny_lm):
+    from tpunet.serve.engine import build_serve_record
+    eng = make_engine(tiny_lm, kv_pages=10, kv_page_tokens=8)
+    snap = eng.registry.snapshot()
+    assert snap["serve_kv_pages_total"] == 10
+    assert snap["serve_kv_pages_used"] == 0
+    assert snap["serve_kv_bytes_per_token"] > 0
+    rec = build_serve_record(eng.registry, queue_depth=0,
+                             active_slots=0, slots=4, uptime_s=1.0,
+                             window_s=1.0)
+    assert rec["kv_pages_total"] == 10
+    assert rec["kv_pages_used"] == 0
+    assert rec["kv_bytes_per_token"] > 0
+
+
+# ---------------------------------------------------------------------------
+# AOT warm-start of the paged + device-sampled program set
+# ---------------------------------------------------------------------------
+
+def test_paged_aot_store_roundtrip(tmp_path, tiny_lm):
+    """The paged decode + fused-sampling program joins the serialized
+    closed set: a second boot deserializes every program ('loaded')
+    and produces token-identical greedy output; flipping a paging
+    lever is a clean store MISS, never a stale executable."""
+    from tpunet.serve.engine import build_aot_store
+
+    model, variables = tiny_lm
+    cfg = ServeConfig(slots=2, queue_max=4, prefill_buckets=(16,),
+                      default_max_new_tokens=8, emit_every_s=0.0,
+                      kv_pages=12, kv_page_tokens=8)
+    store = build_aot_store(str(tmp_path), TINY, cfg)
+    prompt = np.arange(5, dtype=np.int32)
+
+    eng = Engine(model, variables, cfg, aot_store=store).start()
+    try:
+        toks1 = eng.submit(prompt, max_new_tokens=5).result(timeout=120)
+    finally:
+        eng.stop()
+    assert all(v.startswith("compiled") for v in eng.aot_status.values())
+
+    eng2 = Engine(model, variables, cfg, aot_store=store).start()
+    try:
+        toks2 = eng2.submit(prompt, max_new_tokens=5).result(timeout=120)
+    finally:
+        eng2.stop()
+    assert eng2.aot_status == {"w1": "loaded", "w16": "loaded"}
+    assert toks2 == toks1 == solo_greedy(tiny_lm, prompt, 5)
+
+    # A different kv_dtype selects a different program set: clean MISS.
+    cfg_int8 = ServeConfig(slots=2, queue_max=4, prefill_buckets=(16,),
+                           default_max_new_tokens=8, emit_every_s=0.0,
+                           kv_pages=12, kv_page_tokens=8,
+                           kv_dtype="int8")
+    store_int8 = build_aot_store(str(tmp_path), TINY, cfg_int8)
+    eng3 = Engine(model, variables, cfg_int8,
+                  aot_store=store_int8).start()
+    try:
+        eng3.submit(prompt, max_new_tokens=2).result(timeout=120)
+    finally:
+        eng3.stop()
+    assert all(v.startswith("compiled")
+               for v in eng3.aot_status.values())
+
+
+def test_aot_save_is_load_verified(tmp_path, monkeypatch):
+    """save() proves the blob deserializes before committing it — an
+    executable that serializes into an unloadable blob (the persistent-
+    compile-cache poison mode) must yield False and write NOTHING, so
+    a later boot can never trust a poisoned entry."""
+    from jax.experimental import serialize_executable
+
+    from tpunet.utils.cache import AotProgramStore
+
+    store = AotProgramStore(str(tmp_path), "digest")
+    monkeypatch.setattr(serialize_executable, "serialize",
+                        lambda compiled: (b"blob", None, None))
+    monkeypatch.setattr(
+        serialize_executable, "deserialize_and_load",
+        lambda *a: (_ for _ in ()).throw(RuntimeError("Symbols not found")))
+    assert store.save("masked_step", "w16", object()) is False
+    assert not list(tmp_path.iterdir())
+
+    monkeypatch.setattr(serialize_executable, "deserialize_and_load",
+                        lambda *a: object())
+    assert store.save("masked_step", "w16", object()) is True
+    assert any(p.name.endswith(".aotx") for p in tmp_path.iterdir())
+
+
+def test_serializable_compile_restores_cache_flag():
+    """AOT-destined compiles run with the persistent compilation cache
+    OFF (a cache-served executable saves a poison blob) and the flag is
+    restored afterwards, including on the exception path."""
+    from tpunet.utils.cache import serializable_compile
+
+    prev = jax.config.jax_enable_compilation_cache
+    with serializable_compile():
+        assert jax.config.jax_enable_compilation_cache is False
+    assert jax.config.jax_enable_compilation_cache == prev
+    with pytest.raises(ValueError):
+        with serializable_compile():
+            raise ValueError("boom")
+    assert jax.config.jax_enable_compilation_cache == prev

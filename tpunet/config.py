@@ -413,6 +413,37 @@ class ServeConfig:
     # the compile count is len(buckets), not one per prompt length.
     # Prompts longer than the largest bucket are rejected.
     prefill_buckets: Tuple[int, ...] = (32, 128, 512)
+    # Paged KV cache (default ON; --no-paged-kv restores the dense
+    # [slots, max_seq_len] pool): per layer, K/V live in a SHARED pool
+    # of fixed-size pages addressed through per-slot page tables, so a
+    # slot pins HBM proportional to its prompt+generated length — the
+    # concurrent-slot multiplier at fixed HBM (docs/serving.md "Paged
+    # KV cache & device-side sampling").
+    paged_kv: bool = True
+    # Usable data pages in the pool (0 = auto: slots *
+    # ceil(max_seq_len / kv_page_tokens), i.e. dense-equivalent
+    # capacity). Size it DOWN to oversubscribe slots against typical
+    # request lengths; exhaustion defers admissions and, when nothing
+    # can advance, preempts the youngest slot back to the queue with
+    # its progress kept.
+    kv_pages: int = 0
+    # Tokens per KV page: the allocation granule. Smaller pages track
+    # request length tighter (less tail waste) at more gather/table
+    # overhead per step.
+    kv_page_tokens: int = 16
+    # KV page payload dtype: "auto" stores at the model compute dtype;
+    # "bf16" halves float32 payloads; "int8" quantizes each written
+    # token row against its own absmax (float32 scale stored with the
+    # page, dequantized on gather; eval-parity-gated in
+    # tests/test_serve_paged.py). Requires paged_kv.
+    kv_dtype: str = "auto"
+    # Device-side batched sampling (default ON; --no-device-sampling
+    # restores the host loop): temperature/top-k/top-p and the
+    # categorical draw run as one [slots]-wide jitted step fused onto
+    # decode (per-slot PRNG keys folded per step) — only sampled
+    # tokens cross the host boundary. Greedy output is token-identical
+    # either way (parity-tested).
+    device_sampling: bool = True
     # Per-request caps: default/max new tokens, and a wall-clock
     # deadline after which a request is cancelled and its slot freed
     # (0 = no deadline).

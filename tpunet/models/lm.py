@@ -56,7 +56,8 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False,
                  pos_offset=0, segment_ids=None,
-                 return_hidden: bool = False, decode_active=None):
+                 return_hidden: bool = False, decode_active=None,
+                 paged_kv=None, page_table=None):
         """``decode=True``: incremental step against the KV cache (one
         token per call after cache init); ``pos_offset`` is the absolute
         position of ``tokens[:, 0]`` in the sequence — a scalar, or an
@@ -71,7 +72,11 @@ class TransformerLM(nn.Module):
         final-LN hidden states [B, T, C] float32 instead of logits —
         the vocab-sharded CE hook (tpunet/ops/vocab_ce.py): the caller
         computes the loss against the tied embedding without ever
-        materializing the [B, T, V] logits."""
+        materializing the [B, T, V] logits. ``paged_kv`` (a
+        ``models.vit.PagedKV``) + ``page_table`` [B, pages-per-row]
+        int32 switch the decode KV cache to the shared page pool
+        (tpunet/serve paged continuous batching; needs per-row
+        ``pos_offset``)."""
         b, t = tokens.shape
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
@@ -113,7 +118,7 @@ class TransformerLM(nn.Module):
                              name=f"block{i:02d}")(
                                  x, train, decode, segment_ids,
                                  pos_offset if per_row else None,
-                                 decode_active)
+                                 decode_active, paged_kv, page_table)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln")(x)
         if return_hidden:

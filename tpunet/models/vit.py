@@ -39,6 +39,63 @@ from tpunet.ops import blockwise_attention, dense_attention
 AttnFn = Callable[..., jax.Array]  # (q, k, v) BTHD -> BTHD
 
 
+@dataclasses.dataclass(frozen=True)
+class PagedKV:
+    """Paged KV-cache geometry (tpunet/serve continuous batching).
+
+    The dense decode cache pins ``[B, max_seq_len]`` K/V rows per
+    layer for every slot regardless of how far the slot has actually
+    decoded. Paged mode replaces it with a SHARED page pool: K/V live
+    in ``pages`` fixed-size pages of ``page_tokens`` tokens each, and
+    every batch row addresses its tokens through a per-row page table
+    (``page_table`` [B, ceil(max_seq_len/page_tokens)] int32 page
+    ids). A slot then costs HBM proportional to its prompt+generated
+    length, not ``max_seq_len`` — the engine (tpunet/serve/engine.py)
+    owns allocation (allocate-on-advance, free-on-finish, recycling).
+
+    Page 0 is RESERVED as the garbage page: inactive rows and the
+    padded tail of a bucketed prefill scatter their writes there, and
+    the host allocator never hands it to a slot — the write gate is an
+    index redirect, not a select over the whole pool.
+
+    ``dtype`` selects the page payload: "auto" stores at the compute
+    dtype, "bfloat16" halves float32 payloads, "int8" quantizes each
+    written token row against its own absmax with the float32 scale
+    stored alongside the page (per page-row scale — a single scalar
+    per page cannot absorb incremental writes without rescaling the
+    whole page) and dequantizes on gather.
+    """
+
+    pages: int            # total pages INCLUDING the reserved page 0
+    page_tokens: int      # tokens per page
+    dtype: str = "auto"   # auto | bfloat16 | int8
+
+    def store_dtype(self, compute_dtype):
+        if self.dtype == "auto":
+            return compute_dtype
+        if self.dtype in ("bfloat16", "bf16"):
+            return jnp.bfloat16
+        if self.dtype == "int8":
+            return jnp.int8
+        raise ValueError(f"unknown kv dtype {self.dtype!r}")
+
+    @property
+    def quantized(self) -> bool:
+        return self.dtype == "int8"
+
+
+def _quantize_kv_rows(x):
+    """Symmetric int8 per-row quantization of ``x`` [N, H, D]: each
+    token row is scaled by its own absmax over (H, D) so one outlier
+    token cannot crush every other row's resolution. Returns
+    (int8 rows, float32 scale [N])."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(1, 2))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[:, None, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
 class Attention(nn.Module):
     """Multi-head self-attention with an injected core attention op.
 
@@ -69,7 +126,8 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
-                 segment_ids=None, positions=None, active=None):
+                 segment_ids=None, positions=None, active=None,
+                 paged_kv=None, page_table=None):
         b, t, c = x.shape
         if c % self.heads:
             raise ValueError(
@@ -80,7 +138,8 @@ class Attention(nn.Module):
         qkv = qkv.reshape(b, t, 3, self.heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if decode:
-            y = self._decode_attend(q, k, v, positions, active)
+            y = self._decode_attend(q, k, v, positions, active,
+                                    paged_kv, page_table)
         elif segment_ids is not None:
             # Packed sequences: same-segment masking in the core. The
             # dense/flash cores and Ulysses SP take the kwarg (packed
@@ -97,7 +156,11 @@ class Attention(nn.Module):
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return y
 
-    def _decode_attend(self, q, k, v, positions=None, active=None):
+    def _decode_attend(self, q, k, v, positions=None, active=None,
+                       paged_kv=None, page_table=None):
+        if paged_kv is not None:
+            return self._paged_decode_attend(q, k, v, positions, active,
+                                             paged_kv, page_table)
         is_init = not self.has_variable("cache", "cached_k")
         ck = self.variable("cache", "cached_k", jnp.zeros, k.shape, k.dtype)
         cv = self.variable("cache", "cached_v", jnp.zeros, v.shape, v.dtype)
@@ -150,6 +213,99 @@ class Attention(nn.Module):
                        preferred_element_type=jnp.float32)
         return y.astype(q.dtype)
 
+    def _paged_decode_attend(self, q, k, v, positions, active,
+                             paged_kv, page_table):
+        """Paged decode: K/V live in a SHARED flat page pool
+        ``[pages * page_tokens, H, D]`` per layer; each row's logical
+        position p maps to flat row
+        ``page_table[b, p // page_tokens] * page_tokens + p %
+        page_tokens``. Writes are one scatter over the new rows
+        (inactive rows and unallocated positions are redirected into
+        the reserved garbage page 0); the attend gathers the row's
+        pages back into position order and runs the exact dense masked
+        attention math over them — causality (j <= qpos per row) makes
+        garbage beyond each row's own written prefix invisible, the
+        same invariant the dense bucketed prefill already relies on.
+
+        int8 pages carry a float32 scale per page row (written in the
+        same scatter) and dequantize on gather. The engine owns page
+        allocation; this method never sees a free list."""
+        b, t = q.shape[0], q.shape[1]
+        heads, head_dim = k.shape[2], k.shape[3]
+        pt = paged_kv.page_tokens
+        flat_rows = paged_kv.pages * pt
+        store_dtype = paged_kv.store_dtype(k.dtype)
+        is_init = not self.has_variable("cache", "cached_k")
+        ck = self.variable("cache", "cached_k", jnp.zeros,
+                           (flat_rows, heads, head_dim), store_dtype)
+        cv = self.variable("cache", "cached_v", jnp.zeros,
+                           (flat_rows, heads, head_dim), store_dtype)
+        if paged_kv.quantized:
+            sk = self.variable("cache", "scale_k", jnp.zeros,
+                               (flat_rows,), jnp.float32)
+            sv = self.variable("cache", "scale_v", jnp.zeros,
+                               (flat_rows,), jnp.float32)
+        if is_init:
+            # Cache-creation pass (positions legitimately absent):
+            # buffers sized above, attention skipped like the dense
+            # init path.
+            return jnp.zeros_like(q)
+        if positions is None or page_table is None:
+            raise ValueError("paged decode requires engine-owned "
+                             "per-row positions and a page table")
+
+        # -- write: new K/V rows scattered to their flat page rows ----
+        pos_t = positions[:, None] + jnp.arange(t)[None, :]     # [B, T]
+        page_slot = jnp.clip(pos_t // pt, 0, page_table.shape[1] - 1)
+        page_ids = jnp.take_along_axis(page_table, page_slot, axis=1)
+        flat_idx = page_ids * pt + pos_t % pt                   # [B, T]
+        if active is not None:
+            # Inactive rows write into the garbage page instead of
+            # being where()-gated over the whole pool.
+            flat_idx = jnp.where(active[:, None], flat_idx, 0)
+        flat_idx = flat_idx.reshape(-1)
+        k_rows = k.reshape(b * t, heads, head_dim)
+        v_rows = v.reshape(b * t, heads, head_dim)
+        if paged_kv.quantized:
+            k_q, k_s = _quantize_kv_rows(k_rows)
+            v_q, v_s = _quantize_kv_rows(v_rows)
+            ck.value = ck.value.at[flat_idx].set(k_q)
+            cv.value = cv.value.at[flat_idx].set(v_q)
+            sk.value = sk.value.at[flat_idx].set(k_s)
+            sv.value = sv.value.at[flat_idx].set(v_s)
+        else:
+            ck.value = ck.value.at[flat_idx].set(
+                k_rows.astype(store_dtype))
+            cv.value = cv.value.at[flat_idx].set(
+                v_rows.astype(store_dtype))
+
+        # -- gather: each row's pages back into position order --------
+        n_page_slots = page_table.shape[1]
+        rows = (page_table[:, :, None] * pt
+                + jnp.arange(pt)[None, None, :]).reshape(b, -1)  # [B, K]
+        kf = jnp.take(ck.value, rows, axis=0)
+        vf = jnp.take(cv.value, rows, axis=0)
+        if paged_kv.quantized:
+            kf = kf.astype(jnp.float32) \
+                * jnp.take(sk.value, rows, axis=0)[..., None, None]
+            vf = vf.astype(jnp.float32) \
+                * jnp.take(sv.value, rows, axis=0)[..., None, None]
+        kf = kf.astype(q.dtype)
+        vf = vf.astype(q.dtype)
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+                       preferred_element_type=jnp.float32)
+        s = s * (q.shape[-1] ** -0.5)
+        from tpunet.ops.attention import _NEG_INF
+        qpos = pos_t                                            # [B, T]
+        valid = (jnp.arange(n_page_slots * pt)[None, None, :]
+                 <= qpos[:, :, None])                           # [B,T,K]
+        s = jnp.where(valid[:, None, :, :], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        y = jnp.einsum("bhqk,bkhd->bqhd", p, vf,
+                       preferred_element_type=jnp.float32)
+        return y.astype(q.dtype)
+
 
 class MlpBlock(nn.Module):
     """Transformer MLP: Dense -> GELU -> Dense."""
@@ -192,14 +348,16 @@ class EncoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
-                 segment_ids=None, positions=None, active=None):
+                 segment_ids=None, positions=None, active=None,
+                 paged_kv=None, page_table=None):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln1")(x)
         x = x + Attention(self.heads, attn_fn=self.attn_fn,
                           dropout_rate=self.dropout_rate, dtype=self.dtype,
                           param_dtype=self.param_dtype,
                           name="attn")(y, train, decode, segment_ids,
-                                       positions, active)
+                                       positions, active, paged_kv,
+                                       page_table)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln2")(x)
         if self.moe_experts > 0:

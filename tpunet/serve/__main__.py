@@ -77,6 +77,35 @@ def build_argparser():
         str(b) for b in d.prefill_buckets),
         help="comma-separated padded prompt-length buckets (compile "
              "count = number of buckets)")
+    p.add_argument("--paged-kv", default=d.paged_kv,
+                   action=argparse.BooleanOptionalAction,
+                   help="paged KV cache (default on): K/V in a shared "
+                        "page pool with per-slot page tables, so a "
+                        "slot costs prompt-proportional HBM; "
+                        "--no-paged-kv restores the dense "
+                        "[slots, max_seq_len] pool")
+    p.add_argument("--kv-pages", type=int, default=d.kv_pages,
+                   help="usable KV pages in the shared pool (0 = "
+                        "dense-equivalent capacity: slots x "
+                        "ceil(max-seq-len / kv-page-tokens)); size it "
+                        "down to oversubscribe slots against typical "
+                        "request lengths")
+    p.add_argument("--kv-page-tokens", type=int,
+                   default=d.kv_page_tokens,
+                   help="tokens per KV page (allocation granule)")
+    p.add_argument("--kv-dtype", default=d.kv_dtype,
+                   choices=["auto", "bf16", "int8"],
+                   help="KV page payload dtype: auto = compute dtype; "
+                        "bf16 halves float32 payloads; int8 "
+                        "quantizes per written token row (float32 "
+                        "scale stored with the page, eval-parity-"
+                        "gated) — halves page cost again")
+    p.add_argument("--device-sampling", default=d.device_sampling,
+                   action=argparse.BooleanOptionalAction,
+                   help="batched temperature/top-k/top-p sampling "
+                        "fused onto the decode step on device "
+                        "(default on); --no-device-sampling restores "
+                        "the host-side per-slot sampler")
     p.add_argument("--max-new-tokens", type=int,
                    default=d.default_max_new_tokens,
                    help="default per-request generation budget")
@@ -175,6 +204,9 @@ def build_server(args):
     cfg = ServeConfig(
         host=args.host, port=args.port, slots=args.slots,
         queue_max=args.queue_max, prefill_buckets=buckets,
+        paged_kv=args.paged_kv, kv_pages=args.kv_pages,
+        kv_page_tokens=args.kv_page_tokens, kv_dtype=args.kv_dtype,
+        device_sampling=args.device_sampling,
         default_max_new_tokens=args.max_new_tokens,
         max_new_tokens_cap=args.max_new_tokens_cap,
         default_deadline_s=args.deadline_s,

@@ -1,4 +1,4 @@
-"""Continuous-batching decode engine over a fixed KV-slot pool.
+"""Continuous-batching decode engine over a paged KV pool.
 
 One jitted masked decode step is compiled ONCE for the pool batch
 ``[slots, 1]`` and amortized across every in-flight request: each
@@ -10,16 +10,33 @@ the same masked path as a chunked multi-token call, padded to one of a
 fixed set of length buckets — the total compile count is bounded at
 ``1 + len(prefill_buckets)`` programs for the life of the server.
 
-Sampling is host-side (per-request temperature/top-k/top-p/seed differ
-across a batch, and argmax on host equals argmax on device), mirroring
-``models.lm.filter_logits`` semantics: top-k first, then the nucleus
-over the renormalized post-top-k distribution. Greedy output is
-token-identical to ``models.lm.generate`` (engine parity test).
+KV memory is PAGED by default (``ServeConfig.paged_kv``;
+``--no-paged-kv`` keeps the dense pool): per layer, K/V live in a
+shared pool of ``kv_pages`` pages of ``kv_page_tokens`` tokens each,
+addressed through per-slot page tables the engine owns host-side. A
+slot costs HBM proportional to its prompt+generated length instead of
+``max_seq_len`` — pages are allocated on advance, freed on finish, and
+recycled; when the pool is exhausted the YOUNGEST blocked slot is
+preempted back to the queue (its progress is kept and resumed by
+re-prefilling prompt+generated, token streams never restart). int8
+page payloads (``kv_dtype``, per page-row scale, eval-parity-gated)
+halve the bf16 page cost again.
+
+Sampling is DEVICE-side by default (``ServeConfig.device_sampling``):
+one ``[slots]``-wide batched temperature/top-k/top-p step
+(tpunet/serve/sampling.py, per-slot PRNG keys folded per step) is
+fused onto the decode program, so only sampled int32 tokens cross the
+host boundary — the per-slot host loop (and the ``[slots, V]`` logits
+transfer feeding it) leaves the token path. ``sample_token`` below is
+the surviving host-side parity reference (and the
+``--no-device-sampling`` fallback); greedy output is token-identical
+to ``models.lm.generate`` through either sampler (engine parity test).
 
 Obs wiring: SLO counters/gauges/histograms land in a ``tpunet.obs``
-``Registry`` (serve_* names, docs/metrics_schema.md ``obs_serve``),
-prefill/decode phases run under trace spans, and a periodic
-``obs_serve`` record is emitted to every attached sink/exporter.
+``Registry`` (serve_* names incl. the ``serve_kv_*`` page-pool
+gauges, docs/metrics_schema.md ``obs_serve``), prefill/decode phases
+run under trace spans, and a periodic ``obs_serve`` record is emitted
+to every attached sink/exporter.
 """
 
 from __future__ import annotations
@@ -132,6 +149,15 @@ def build_serve_record(reg, *, queue_depth: int, active_slots: int,
                 round(v, 6) for v in hist.export_sample()]
             if summ.get("approx"):
                 record[f"{key}_approx"] = 1
+    # Paged-KV pool state (serve_kv_* gauges; zeros on a dense pool):
+    # the capacity signal a fleet operator sizes --kv-pages from.
+    for gauge_name, field in (("serve_kv_pages_total", "kv_pages_total"),
+                              ("serve_kv_pages_used", "kv_pages_used")):
+        val = reg.gauge(gauge_name).value
+        record[field] = int(val) if val is not None else 0
+    bpt = reg.gauge("serve_kv_bytes_per_token").value
+    record["kv_bytes_per_token"] = (round(float(bpt), 2)
+                                    if bpt is not None else 0)
     if final:
         record["final"] = True
     return record
@@ -151,6 +177,15 @@ def build_aot_store(directory: str, model_cfg, serve_cfg):
         "model": dataclasses.asdict(model_cfg),
         "slots": serve_cfg.slots,
         "prefill_buckets": list(serve_cfg.prefill_buckets),
+        # The paged-KV + sampling levers each select a DIFFERENT
+        # compiled program (pool layout, fused sampler, page dtype):
+        # fold them in so flipping a flag is a clean miss, never a
+        # stale executable.
+        "paged_kv": serve_cfg.paged_kv,
+        "kv_pages": serve_cfg.kv_pages,
+        "kv_page_tokens": serve_cfg.kv_page_tokens,
+        "kv_dtype": serve_cfg.kv_dtype,
+        "device_sampling": serve_cfg.device_sampling,
     })
     return AotProgramStore(directory, digest)
 
@@ -158,13 +193,17 @@ def build_aot_store(directory: str, model_cfg, serve_cfg):
 class _Slot:
     """Host-side bookkeeping for one KV-cache row."""
 
-    __slots__ = ("req", "pos", "next_token", "generated")
+    __slots__ = ("req", "pos", "next_token", "generated", "pages",
+                 "seq")
 
-    def __init__(self, req: GenerateRequest, pos: int, next_token: int):
+    def __init__(self, req: GenerateRequest, pos: int, next_token: int,
+                 generated: int = 1, seq: int = 0):
         self.req = req
         self.pos = pos            # next cache write position
         self.next_token = next_token
-        self.generated = 1        # first token came from prefill
+        self.generated = generated  # tokens produced (resume-aware)
+        self.pages: List[int] = []  # paged-KV pages this slot holds
+        self.seq = seq            # admission ordinal (preempt youngest)
 
 
 class Engine:
@@ -200,6 +239,42 @@ class Engine:
         self.queue = RequestQueue(cfg.queue_max,
                                   on_finish=self._account_finish)
         self._active: List[Optional[_Slot]] = [None] * self.slots
+
+        # -- paged KV geometry (host-owned allocator) ------------------
+        self.device_sampling = bool(cfg.device_sampling)
+        self.page_tokens = int(cfg.kv_page_tokens)
+        if self.page_tokens < 1:
+            raise ValueError(
+                f"kv_page_tokens must be >= 1, got {cfg.kv_page_tokens}")
+        self.pages_per_slot = -(-self.max_seq_len // self.page_tokens)
+        self._paged_kv = None
+        if cfg.paged_kv:
+            from tpunet.models.vit import PagedKV
+            usable = int(cfg.kv_pages) or self.slots * self.pages_per_slot
+            if usable < 1:
+                raise ValueError(f"kv_pages must be >= 1, got "
+                                 f"{cfg.kv_pages}")
+            self.kv_pages_usable = usable
+            # Free list yields ascending page ids (pop from the end);
+            # freed pages re-enter at the end, so recycling is LIFO —
+            # a just-freed hot page is the next one handed out.
+            self._free_pages = list(range(usable, 0, -1))
+            self._page_table = np.zeros(
+                (self.slots, self.pages_per_slot), np.int32)
+            # pages + 1: page 0 is the reserved garbage page (inactive
+            # rows and padded prefill tails write there; the allocator
+            # never hands it out).
+            self._paged_kv = PagedKV(pages=usable + 1,
+                                     page_tokens=self.page_tokens,
+                                     dtype=cfg.kv_dtype)
+            self._kv_pages_touched: set = set()
+        elif cfg.kv_dtype not in ("auto",):
+            raise ValueError(
+                f"kv_dtype={cfg.kv_dtype!r} requires the paged KV "
+                "cache (drop --no-paged-kv or use kv_dtype auto)")
+        self._admit_seq = 0
+        self.peak_active_slots = 0   # high-water mark (bench_serve
+        #                              --slots-sweep admitted-slot count)
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._draining = threading.Event()
@@ -212,20 +287,44 @@ class Engine:
         self._started = time.perf_counter()
 
         # -- device programs (compiled lazily, one per shape) ----------
-        def _masked_step(params, cache, tokens, positions, active):
-            logits, mutated = model.apply(
-                {"params": params, "cache": cache}, tokens, train=False,
-                decode=True, pos_offset=positions, decode_active=active,
-                mutable=["cache"])
-            return mutated["cache"], logits
-
         # One callable; jit specializes per token shape: [N, 1] decode
         # plus one [N, Lb] program per prefill bucket. The cache is
         # donated — it is the engine's single biggest buffer and every
-        # call replaces it.
+        # call replaces it. With device sampling the batched sampler
+        # is FUSED onto the step (the program returns sampled int32
+        # tokens, not logits); with paging the per-slot page table
+        # rides along as one small int32 input.
+        paged_kv = self._paged_kv
+        fuse_sampler = self.device_sampling
+
+        def _masked_step(params, cache, tokens, positions, active,
+                         *extra):
+            i = 0
+            page_table = None
+            if paged_kv is not None:
+                page_table = extra[i]
+                i += 1
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, tokens, train=False,
+                decode=True, pos_offset=positions, decode_active=active,
+                paged_kv=paged_kv, page_table=page_table,
+                mutable=["cache"])
+            if not fuse_sampler:
+                return mutated["cache"], logits
+            from tpunet.serve.sampling import batched_sample
+            last_idx, temp, top_k, top_p, seeds, steps = extra[i:i + 6]
+            rows = jnp.take_along_axis(
+                logits, last_idx[:, None, None],
+                axis=1)[:, 0].astype(jnp.float32)
+            toks = batched_sample(rows, temp, top_k, top_p, seeds,
+                                  steps)
+            return mutated["cache"], toks
+
         self._step = jax.jit(_masked_step, donate_argnums=(1,))
         self._cache = self._make_cache()
         self._inactive_tok = np.zeros((self.slots, 1), np.int32)
+        self._zero_idx = np.zeros((self.slots,), np.int32)
+        self._init_kv_gauges()
         # AOT warm-start (tpunet/utils/cache.py AotProgramStore): the
         # engine's program set is closed — [N, 1] decode + one [N, Lb]
         # per bucket — so fully-compiled executables deserialize at
@@ -249,15 +348,30 @@ class Engine:
 
         params_s = sds(self.variables["params"])
         cache_s = sds(self._cache)
-        pos_s = jax.ShapeDtypeStruct((self.slots,), np.int32)
+        i32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.int32)  # noqa: E731
+        f32 = lambda *shape: jax.ShapeDtypeStruct(shape, np.float32)  # noqa: E731
+        pos_s = i32(self.slots)
         act_s = jax.ShapeDtypeStruct((self.slots,), bool)
+        extra_s = []
+        if self._paged_kv is not None:
+            extra_s.append(i32(self.slots, self.pages_per_slot))
+        if self.device_sampling:
+            extra_s += [i32(self.slots), f32(self.slots),
+                        i32(self.slots), f32(self.slots),
+                        i32(self.slots), i32(self.slots)]
         for width in (1,) + self.buckets:
             tag = f"w{width}"
             toks_s = jax.ShapeDtypeStruct((self.slots, width), np.int32)
             program = store.load("masked_step", tag)
             if program is None:
-                program = self._step.lower(
-                    params_s, cache_s, toks_s, pos_s, act_s).compile()
+                # Compile fresh (persistent compile cache off): a
+                # cache-served executable saves a poison blob that
+                # fails to deserialize at the next boot.
+                from tpunet.utils.cache import serializable_compile
+                with serializable_compile():
+                    program = self._step.lower(
+                        params_s, cache_s, toks_s, pos_s, act_s,
+                        *extra_s).compile()
                 saved = store.save("masked_step", tag, program)
                 self.aot_status[tag] = ("compiled+saved" if saved
                                         else "compiled")
@@ -265,39 +379,190 @@ class Engine:
                 self.aot_status[tag] = "loaded"
             self._aot[width] = program
 
-    def _dispatch_step(self, toks, positions, active):
+    def _dispatch_step(self, toks, positions, active, last_idx=None):
         """Run one masked-step program: the AOT executable for this
-        token width when warm-started, the jit fallback otherwise."""
+        token width when warm-started, the jit fallback otherwise.
+        Returns (cache, logits) host-sampling, (cache, tokens) with
+        the fused device sampler."""
         program = self._aot.get(toks.shape[1])
         if program is None:
             program = self._step
-        return program(self.variables["params"], self._cache, toks,
-                       positions, active)
+        args = [self.variables["params"], self._cache, toks, positions,
+                active]
+        if self._paged_kv is not None:
+            args.append(self._page_table)
+        if self.device_sampling:
+            args.extend(self._sampling_args(
+                last_idx if last_idx is not None else self._zero_idx))
+        return program(*args)
+
+    def _sampling_args(self, last_idx):
+        """Per-slot sampling parameters for the fused device sampler:
+        temperature/top-k/top-p/seed from each resident request, plus
+        each slot's generated-token count (the per-step key fold — a
+        preempted-and-resumed request continues its exact sample
+        stream)."""
+        n = self.slots
+        temp = np.zeros(n, np.float32)
+        top_k = np.zeros(n, np.int32)
+        top_p = np.zeros(n, np.float32)
+        seeds = np.zeros(n, np.int32)
+        steps = np.zeros(n, np.int32)
+        for i, slot in enumerate(self._active):
+            if slot is None:
+                continue
+            r = slot.req
+            temp[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            seeds[i] = r.seed    # admission-validated into [0, 2**31)
+            steps[i] = len(r.tokens)
+        return [np.asarray(last_idx, np.int32), temp, top_k, top_p,
+                seeds, steps]
 
     # -- pool construction ---------------------------------------------
 
     def _make_cache(self):
         import jax
         import jax.numpy as jnp
+        init_kw = {}
+        if self._paged_kv is not None:
+            init_kw = dict(
+                paged_kv=self._paged_kv,
+                page_table=jnp.zeros((self.slots, self.pages_per_slot),
+                                     jnp.int32))
         shapes = jax.eval_shape(
             lambda: self.model.init(
                 jax.random.PRNGKey(0),
                 jnp.zeros((self.slots, self.max_seq_len), jnp.int32),
-                decode=True))
+                decode=True, **init_kw))
 
         def zeros(s):
             if self.mesh is not None:
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
                 tp = self.mesh.shape.get("model", 1)
-                spec = (P(None, None, "model", None)
-                        if (s.ndim == 4 and tp > 1
-                            and s.shape[2] % tp == 0) else P())
+                if s.ndim == 4 and tp > 1 and s.shape[2] % tp == 0:
+                    spec = P(None, None, "model", None)   # dense pool
+                elif s.ndim == 3 and tp > 1 and s.shape[1] % tp == 0:
+                    spec = P(None, "model", None)         # page pool
+                else:
+                    spec = P()
                 return jnp.zeros(s.shape, s.dtype,
                                  device=NamedSharding(self.mesh, spec))
             return jnp.zeros(s.shape, s.dtype)
 
         return jax.tree_util.tree_map(zeros, shapes["cache"])
+
+    def kv_pool_bytes(self) -> int:
+        """Resident bytes of the KV cache tree (page pool + scales
+        when paged; the dense [slots, max_seq_len] pool otherwise) —
+        the capacity number ``bench_serve.py`` reports per slot."""
+        import jax
+        return int(sum(leaf.nbytes
+                       for leaf in jax.tree_util.tree_leaves(
+                           self._cache)))
+
+    def kv_bytes_per_token(self) -> float:
+        """KV bytes pinned per cacheable token position across the
+        whole pool (pages incl. scale sidecars / dense rows)."""
+        if self._paged_kv is not None:
+            rows = self._paged_kv.pages * self.page_tokens
+        else:
+            rows = self.slots * self.max_seq_len
+        return self.kv_pool_bytes() / max(1, rows)
+
+    def _init_kv_gauges(self) -> None:
+        reg = self.registry
+        reg.gauge("serve_kv_bytes_per_token").set(
+            round(self.kv_bytes_per_token(), 2))
+        if self._paged_kv is not None:
+            reg.gauge("serve_kv_pages_total").set(self.kv_pages_usable)
+            reg.gauge("serve_kv_pages_used").set(0)
+
+    def _update_kv_gauges(self) -> None:
+        if self._paged_kv is not None:
+            self.registry.gauge("serve_kv_pages_used").set(
+                self.kv_pages_usable - len(self._free_pages))
+
+    # -- paged-KV page allocator (engine thread only) -------------------
+
+    def _alloc_pages_for(self, slot_i: int, n_tokens: int):
+        """Allocate pages covering ``n_tokens`` prefill positions for
+        an admission; None when the pool cannot cover it right now
+        (the request stays queued). All-or-nothing."""
+        need = -(-n_tokens // self.page_tokens)
+        if len(self._free_pages) < need:
+            return None
+        pages = [self._free_pages.pop() for _ in range(need)]
+        for j, p in enumerate(pages):
+            self._page_table[slot_i, j] = p
+        self._kv_pages_touched.update(pages)
+        self.registry.counter("serve_kv_page_allocs_total").inc(need)
+        return pages
+
+    def _ensure_page_capacity(self, slot_i: int, slot: _Slot) -> bool:
+        """Allocate-on-advance: make sure the page covering the slot's
+        next write position exists. False = pool exhausted (the slot
+        sits this iteration out, or gets preempted)."""
+        need = slot.pos // self.page_tokens + 1
+        while len(slot.pages) < need:
+            if not self._free_pages:
+                return False
+            p = self._free_pages.pop()
+            self._page_table[slot_i, len(slot.pages)] = p
+            slot.pages.append(p)
+            self._kv_pages_touched.add(p)
+            self.registry.counter("serve_kv_page_allocs_total").inc()
+        return True
+
+    def _release_pages(self, slot_i: int, slot: _Slot) -> None:
+        """Free-on-finish with recycling: the slot's pages re-enter
+        the free list (LIFO) and its table row resets to the garbage
+        page."""
+        if self._paged_kv is None:
+            return
+        self._free_pages.extend(slot.pages)
+        slot.pages = []
+        self._page_table[slot_i, :] = 0
+        self._update_kv_gauges()
+
+    def _choose_preempt_victim(self, blocked) -> int:
+        """Pick the slot index to preempt from ``blocked``
+        [(slot_i, slot), ...]: the YOUNGEST admission whose resume
+        prefill (prompt + generated) still fits a bucket. Preempting
+        an unresumable slot turns transient pool pressure into a
+        client-visible error, so one is chosen only when every
+        blocked slot is unresumable (then the youngest fails —
+        unavoidable, but never a healthy request while a resumable
+        victim exists). Oldest-resumable-survives keeps forward
+        progress: the surviving residents eventually finish and free
+        pages."""
+        largest = self.buckets[-1]
+        resumable = [it for it in blocked
+                     if it[1].req.prompt.size
+                     + len(it[1].req.tokens) <= largest]
+        pool = resumable if resumable else blocked
+        return max(pool, key=lambda it: it[1].seq)[0]
+
+    def _preempt_slot(self, slot_i: int) -> None:
+        """Pool exhausted and nothing can advance: push the youngest
+        blocked request back to the HEAD of the queue with its
+        progress intact (tokens already streamed stay valid; on
+        re-admission the engine re-prefills prompt+generated and the
+        sample stream continues at its per-step key fold)."""
+        slot = self._active[slot_i]
+        self._active[slot_i] = None
+        self._release_pages(slot_i, slot)
+        req = slot.req
+        req.preemptions += 1
+        self.registry.counter("serve_kv_preemptions_total").inc()
+        from tpunet.obs import flightrec
+        flightrec.record("req", f"preempt {req.id}")
+        self.queue.requeue_front([req])
+        self.registry.gauge("serve_active_slots").set(
+            self.active_slots())
+        self.registry.gauge("serve_queue_depth").set(self.queue.depth())
 
     # -- public API ------------------------------------------------------
 
@@ -336,18 +601,23 @@ class Engine:
 
     def submit(self, prompt, **kw) -> GenerateRequest:
         """Admit a request (or raise QueueFullError / DrainingError /
-        PromptTooLongError / ValueError). Clamps max_new_tokens to the
-        KV length; never blocks."""
+        PromptTooLongError / ValueError). The generation budget is
+        clamped to the operator cap and the KV length, but never
+        silently: ``req.requested_max_new_tokens`` keeps what the
+        client asked for, ``req.max_new_tokens`` is the EFFECTIVE
+        budget the frontend reports back. Never blocks."""
         if self.error is not None:
             from tpunet.serve.scheduler import DrainingError
             raise DrainingError(f"engine failed: {self.error}")
         kw.setdefault("max_new_tokens", self.cfg.default_max_new_tokens)
-        kw["max_new_tokens"] = min(int(kw["max_new_tokens"]),
+        requested = int(kw["max_new_tokens"])
+        kw["max_new_tokens"] = min(requested,
                                    self.cfg.max_new_tokens_cap)
         if (kw.get("deadline_s") or 0) <= 0 \
                 and self.cfg.default_deadline_s > 0:
             kw["deadline_s"] = self.cfg.default_deadline_s
         req = GenerateRequest(prompt, **kw)
+        req.requested_max_new_tokens = requested
         try:
             n = int(req.prompt.size)
             self.bucket_for(n)  # raises PromptTooLongError
@@ -357,6 +627,17 @@ class Engine:
                     raise PromptTooLongError(
                         f"prompt of {n} tokens leaves no room to "
                         f"generate (max_seq_len {self.max_seq_len})")
+            if self._paged_kv is not None:
+                # Completability guard: a request whose FULL length
+                # cannot fit the page pool even alone would preempt
+                # itself forever — reject it up front instead.
+                worst = -(-(n + req.max_new_tokens) // self.page_tokens)
+                if worst > self.kv_pages_usable:
+                    raise PromptTooLongError(
+                        f"request needs {worst} KV pages at full "
+                        f"length but the pool has "
+                        f"{self.kv_pages_usable}; lower "
+                        "max_new_tokens or grow --kv-pages")
             self.queue.submit(req)       # may raise QueueFull/Draining
         except Exception:
             self.registry.counter("serve_requests_rejected").inc()
@@ -530,13 +811,18 @@ class Engine:
     def _finish_slot(self, i: int, reason: str) -> None:
         slot = self._active[i]
         self._active[i] = None
+        self._release_pages(i, slot)
         slot.req.finish(reason)
         self._account_finish(slot.req, reason)
         self.registry.gauge("serve_active_slots").set(self.active_slots())
 
     def _admit(self) -> bool:
         """Admit waiting requests into free slots and prefill them,
-        grouped by bucket so each group is one device call."""
+        grouped by bucket so each group is one device call. Paged KV:
+        admission is FIFO and all-or-nothing per request — when the
+        pool cannot cover the next request's prompt, it (and everyone
+        behind it) goes back to the queue head until pages free up."""
+        import collections
         free = [i for i, s in enumerate(self._active) if s is None]
         if not free:
             return False
@@ -550,54 +836,120 @@ class Engine:
             # wedged call would hang an officially-idle thread and the
             # thread_stalled watchdog would never fire.
             self._thread_handle.beat("busy")
+        admitted = []        # (slot_i, bucket, req, resume_tokens)
+        pending = collections.deque(reqs)
+        free_iter = iter(free)
+        slot_i = next(free_iter, None)
+        while pending and slot_i is not None:
+            req = pending[0]
+            # Resume-prefill for preempted requests: re-embed the
+            # prompt PLUS everything already generated, so the slot
+            # picks up exactly where it left off.
+            if req.tokens:
+                resume = np.concatenate(
+                    [req.prompt, np.asarray(req.tokens, np.int32)])
+            else:
+                resume = req.prompt
+            try:
+                bucket = self.bucket_for(int(resume.size))
+            except PromptTooLongError as e:
+                # A resumed request can outgrow the largest prefill
+                # bucket; it cannot be re-prefilled — fail it loudly
+                # rather than wedge the queue head.
+                pending.popleft()
+                req.finish(FINISH_ERROR, error=f"preempt-resume: {e}")
+                self._account_finish(req, FINISH_ERROR)
+                continue
+            if self._paged_kv is not None:
+                pages = self._alloc_pages_for(slot_i, int(resume.size))
+                if pages is None:
+                    break            # pool pressure: FIFO order holds
+            else:
+                pages = []
+            pending.popleft()
+            admitted.append((slot_i, bucket, req, resume, pages))
+            slot_i = next(free_iter, None)
+        if pending:
+            self.queue.requeue_front(pending)
+            self.registry.gauge("serve_queue_depth").set(
+                self.queue.depth())
+        if not admitted:
+            return False
         by_bucket = {}
-        for req, slot_i in zip(reqs, free):
-            by_bucket.setdefault(self.bucket_for(req.prompt.size),
-                                 []).append((slot_i, req))
+        for slot_i, bucket, req, resume, pages in admitted:
+            by_bucket.setdefault(bucket, []).append(
+                (slot_i, req, resume, pages))
         for bucket, group in sorted(by_bucket.items()):
             self._prefill(bucket, group)
-        self.registry.gauge("serve_active_slots").set(self.active_slots())
+        self._update_kv_gauges()
+        now_active = self.active_slots()
+        self.peak_active_slots = max(self.peak_active_slots, now_active)
+        self.registry.gauge("serve_active_slots").set(now_active)
         return True
 
     def _prefill(self, bucket: int, group) -> None:
         """One chunked-prefill device call for every admitted request
-        padded to this bucket; K/V land in each slot's cache row and
-        the first token is sampled from the last REAL prompt position.
-        The padded tail writes garbage K/V beyond the prompt — masked
-        invariant: a decode query at position p attends only j <= p and
-        overwrites position p first, so padding is never visible."""
+        padded to this bucket; K/V land in each slot's cache rows (or
+        pages) and the next token is sampled from the last REAL
+        position — on device when the sampler is fused, else from the
+        transferred logits row. The padded tail writes garbage K/V
+        beyond the prompt — masked invariant: a decode query at
+        position p attends only j <= p and overwrites position p
+        first, so padding is never visible. ``group`` rows are
+        ``(slot_i, req, resume_tokens, pages)``; resume_tokens is
+        prompt+generated for a preempted request resuming mid-stream.
+        """
         t0 = time.perf_counter()
         toks = np.zeros((self.slots, bucket), np.int32)
         active = np.zeros((self.slots,), bool)
-        for slot_i, req in group:
-            toks[slot_i, :req.prompt.size] = req.prompt
+        last_idx = np.zeros((self.slots,), np.int32)
+        for slot_i, req, resume, pages in group:
+            n = int(resume.size)
+            toks[slot_i, :n] = resume
             active[slot_i] = True
+            last_idx[slot_i] = n - 1
             # Slot the request BEFORE the device call: if the step
             # raises, the engine's failure handler finds (and fails)
             # it in _active instead of stranding a popped request.
-            self._active[slot_i] = _Slot(req, pos=req.prompt.size,
-                                         next_token=0)
+            self._admit_seq += 1
+            slot = _Slot(req, pos=n, next_token=0,
+                         generated=len(req.tokens) + 1,
+                         seq=self._admit_seq)
+            slot.pages = pages
+            self._active[slot_i] = slot
         positions = np.zeros((self.slots,), np.int32)
         from tpunet.obs import flightrec
-        for _, req in group:
+        for _, req, _, _ in group:
             flightrec.record("req", f"prefill {req.id}")
         with _ring_span("tpunet/serve_prefill"):
-            self._cache, logits = self._dispatch_step(toks, positions,
-                                                      active)
-            logits = np.asarray(logits)
+            if self.device_sampling:
+                self._cache, sampled = self._dispatch_step(
+                    toks, positions, active, last_idx)
+                sampled = np.asarray(sampled)
+                logits = None
+            else:
+                self._cache, logits = self._dispatch_step(toks,
+                                                          positions,
+                                                          active)
+                logits = np.asarray(logits)
         reg = self.registry
-        for slot_i, req in group:
-            n = req.prompt.size
-            first = sample_token(logits[slot_i, n - 1], req)
+        for slot_i, req, resume, _ in group:
+            n = int(resume.size)
+            if self.device_sampling:
+                first = int(sampled[slot_i])
+            else:
+                first = sample_token(logits[slot_i, n - 1], req)
+            fresh = req.first_token_t is None
             self._active[slot_i].next_token = first
             req.push_token(first)
-            flightrec.record("req", f"first_token {req.id}")
+            if fresh:
+                flightrec.record("req", f"first_token {req.id}")
+                reg.histogram("serve_ttft_s").observe(req.ttft_s)
             reg.counter("serve_tokens_total").inc()
-            reg.histogram("serve_ttft_s").observe(req.ttft_s)
             self._slot_maybe_finish(slot_i, first)
         reg.counter("serve_prefills_total").inc()
         reg.counter("serve_prefill_tokens_total").inc(
-            sum(r.prompt.size for _, r in group))
+            sum(int(r.size) for _, _, r, _ in group))
         reg.histogram("serve_prefill_s").observe(
             time.perf_counter() - t0)
 
@@ -618,11 +970,30 @@ class Engine:
     def _decode_iteration(self) -> bool:
         """One masked decode step across the whole pool: every active
         slot consumes its pending token at its own position and samples
-        the next one."""
+        the next one (fused on device by default). Paged KV: each
+        slot's next write page is allocated here (allocate-on-advance);
+        a slot the pool cannot extend sits the iteration out, and when
+        NOTHING can advance the youngest blocked slot is preempted back
+        to the queue so the others drain and free pages."""
         live = [(i, s) for i, s in enumerate(self._active)
                 if s is not None]
         if not live:
             return False
+        if self._paged_kv is not None:
+            ready = []
+            blocked = []
+            for i, slot in live:
+                if self._ensure_page_capacity(i, slot):
+                    ready.append((i, slot))
+                else:
+                    blocked.append((i, slot))
+            if blocked and not ready:
+                self._preempt_slot(self._choose_preempt_victim(blocked))
+                return True          # freed pages; retry next iteration
+            self._update_kv_gauges()
+            live = ready
+            if not live:
+                return False
         t0 = time.perf_counter()
         toks = self._inactive_tok.copy()
         positions = np.zeros((self.slots,), np.int32)
@@ -632,9 +1003,16 @@ class Engine:
             positions[i] = slot.pos
             active[i] = True
         with _ring_span("tpunet/serve_decode"):
-            self._cache, logits = self._dispatch_step(toks, positions,
-                                                      active)
-            logits = np.asarray(logits)
+            if self.device_sampling:
+                self._cache, sampled = self._dispatch_step(
+                    toks, positions, active, self._zero_idx)
+                sampled = np.asarray(sampled)
+                logits = None
+            else:
+                self._cache, logits = self._dispatch_step(toks,
+                                                          positions,
+                                                          active)
+                logits = np.asarray(logits)
         lap = time.perf_counter() - t0
         reg = self.registry
         reg.counter("serve_decode_steps_total").inc()
@@ -643,7 +1021,10 @@ class Engine:
         # live slot, each of which waited the full iteration.
         reg.histogram("serve_token_s").observe(lap)
         for i, slot in live:
-            nxt = sample_token(logits[i, 0], slot.req)
+            if self.device_sampling:
+                nxt = int(sampled[i])
+            else:
+                nxt = sample_token(logits[i, 0], slot.req)
             slot.pos += 1
             slot.next_token = nxt
             slot.generated += 1

@@ -262,11 +262,18 @@ def _make_handler(server: ServeServer):
                 "id": req.id,
                 "tokens": tokens,
                 "finish_reason": req.finish_reason,
+                # The EFFECTIVE generation budget after the admission
+                # clamp (operator cap / KV length) — a response shorter
+                # than the ask is attributable to the clamp, not a bug.
+                "max_new_tokens": req.max_new_tokens,
                 "ttft_ms": round(1e3 * req.ttft_s, 3)
                 if req.ttft_s is not None else None,
                 "e2e_ms": round(1e3 * req.e2e_s, 3)
                 if req.e2e_s is not None else None,
             }
+            if req.requested_max_new_tokens != req.max_new_tokens:
+                out["requested_max_new_tokens"] = \
+                    req.requested_max_new_tokens
             text = _token_text(tokens, server.vocab_size)
             if text is not None:
                 out["text"] = text
@@ -295,10 +302,16 @@ def _make_handler(server: ServeServer):
                             ev["text"] = text
                         chunk(ev)
                     else:
-                        chunk({"done": True, "finish_reason": val,
-                               "n_tokens": len(req.tokens),
-                               "ttft_ms": round(1e3 * req.ttft_s, 3)
-                               if req.ttft_s is not None else None})
+                        done = {"done": True, "finish_reason": val,
+                                "n_tokens": len(req.tokens),
+                                "max_new_tokens": req.max_new_tokens,
+                                "ttft_ms": round(1e3 * req.ttft_s, 3)
+                                if req.ttft_s is not None else None}
+                        if req.requested_max_new_tokens \
+                                != req.max_new_tokens:
+                            done["requested_max_new_tokens"] = \
+                                req.requested_max_new_tokens
+                        chunk(done)
                 self.wfile.write(b"0\r\n\r\n")
             except TimeoutError:
                 # Wedged engine: free the slot and tell the (still

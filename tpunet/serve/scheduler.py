@@ -67,10 +67,25 @@ class GenerateRequest:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        # What the CLIENT asked for, before any admission clamp (cap /
+        # KV length). ``max_new_tokens`` becomes the EFFECTIVE budget;
+        # the frontend reports both so a silently-shortened response
+        # is attributable to the clamp, not a bug.
+        self.requested_max_new_tokens = self.max_new_tokens
+        # Times this request was preempted out of its slot (paged-KV
+        # pool exhaustion) and re-queued for resume-prefill.
+        self.preemptions = 0
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.seed = int(seed)
+        # Both sampler backends need this range: numpy's Generator
+        # rejects negatives (an engine-thread raise marks the whole
+        # engine dead) and the device path folds the seed into an
+        # int32 lane (values past bit 31 would silently collide).
+        if not 0 <= self.seed < 2 ** 31:
+            raise ValueError(
+                f"seed must be in [0, 2**31), got {seed}")
         self.stop_token = stop_token
         self.submitted_t = time.perf_counter()
         self.deadline_t = (self.submitted_t + deadline_s
@@ -198,6 +213,17 @@ class RequestQueue:
                 raise QueueFullError(
                     f"admission queue full ({self.queue_max} waiting)")
             self._waiting.append(req)
+
+    def requeue_front(self, reqs) -> None:
+        """Put already-admitted requests BACK at the head of the queue
+        (paged-KV preemption, or an admission wave that ran out of
+        pages mid-batch). Deliberately ignores ``closed`` and the
+        bound: these requests were already admitted once — bouncing
+        them now would turn a transient pool-pressure event into a
+        client-visible failure."""
+        with self._lock:
+            for req in reversed(list(reqs)):
+                self._waiting.appendleft(req)
 
     def pop_ready(self, n: int) -> List[GenerateRequest]:
         """Pop up to ``n`` admissible requests FIFO. Requests that were

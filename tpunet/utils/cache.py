@@ -23,6 +23,7 @@ changed model config or runtime can never load a stale executable.
 
 from __future__ import annotations
 
+import contextlib
 import getpass
 import hashlib
 import os
@@ -59,6 +60,28 @@ def enable_persistent_compile_cache(directory: str | None = None) -> None:
         or cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+@contextlib.contextmanager
+def serializable_compile():
+    """Compile with the persistent compilation cache OFF.
+
+    An executable whose compile was SERVED from XLA's persistent cache
+    serializes without error into a blob that fails
+    ``deserialize_and_load`` at the next boot ("Symbols not found:
+    [..._fusion ...]"), silently poisoning the AOT store. Wrap the
+    ``.lower().compile()`` of any program destined for ``save`` in
+    this so the executable is built fresh and self-contained; the
+    cache setting is restored on exit.
+    """
+    import jax
+
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev)
 
 
 class AotProgramStore:
@@ -129,6 +152,12 @@ class AotProgramStore:
         try:
             blob, in_tree, out_tree = serialize_executable.serialize(
                 compiled)
+            # Prove the roundtrip NOW: a cache-served executable (see
+            # serializable_compile) serializes without error into a
+            # blob that cannot be loaded back — a boot must never
+            # trust an entry that was not load-verified at save time.
+            serialize_executable.deserialize_and_load(
+                blob, in_tree, out_tree)
             os.makedirs(self.directory, exist_ok=True)
             path = self._path(name, shape_tag)
             tmp = path + f".tmp.{os.getpid()}"
