@@ -522,6 +522,7 @@ def run_router_bench(args) -> dict:
             snap = _get_json(base + "/metrics")
             for key in ("router_requests_total", "router_rerouted_total",
                         "router_rejected_total",
+                        "router_failovers_total",
                         "router_evictions_total",
                         "router_respawns_total"):
                 if key in snap:

@@ -327,8 +327,8 @@ def collect_router_records() -> list:
     sink = MemorySink()
     reg.add_sink(sink)
     for name in ("requests", "rerouted", "rejected", "affinity_hits",
-                 "evictions", "respawns", "scale_ups", "scale_downs",
-                 "probe_failures"):
+                 "failovers", "evictions", "respawns", "scale_ups",
+                 "scale_downs", "probe_failures"):
         reg.counter(f"router_{name}_total").inc(2)
     for i in range(5):
         reg.histogram("router_e2e_s").observe(0.02 * (i + 1))
@@ -360,6 +360,10 @@ def collect_router_records() -> list:
     reg.emit("obs_router", build_router_event(
         "scale_down", replica="r0", cause="policy", old_replicas=3,
         new_replicas=2))
+    reg.emit("obs_router", build_router_event(
+        "failover", replica="r0", url="http://127.0.0.1:8000",
+        cause="replica_failed_mid_stream",
+        detail={"tokens_relayed": 5}))
     return sink.records
 
 

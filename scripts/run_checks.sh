@@ -85,12 +85,18 @@ fi
 # drain Retry-After, webhook eviction, obs_router reconciliation.
 run_gate "router-smoke" python scripts/router_smoke.py
 
+# Serve-tier chaos matrix against stdlib stub replicas: mid-stream
+# failover (kill/wedge/prefill-death) + the journal-cap degradation.
+# --slow adds the real-engine leg (SIGKILL of a real serve child).
+run_gate "serve-chaos-smoke" python scripts/serve_chaos_smoke.py
+
 run_gate "sanitizer-smoke" python scripts/check_sanitizers.py --smoke
 
 if [ "$SLOW" = 1 ]; then
   run_gate "sanitizers-full" python scripts/check_sanitizers.py
   run_gate "obs-overhead" python scripts/check_obs_overhead.py
   run_gate "chaos-smoke" python scripts/chaos_smoke.py
+  run_gate "serve-chaos-real" python scripts/serve_chaos_smoke.py --real
 fi
 
 echo

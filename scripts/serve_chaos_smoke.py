@@ -1,0 +1,409 @@
+#!/usr/bin/env python
+"""Serve-tier chaos matrix: mid-stream failover, end-to-end.
+
+The fast set (default) drives the ROUTER's failover machinery against
+stdlib stub replicas — no engine, no model, no device — so the gate
+runs in seconds and failures point at router logic, not at jax. The
+stubs speak the real replica stream contract (ndjson token events
+with ``i`` indices, ``resume_tokens`` continuation, the done frame)
+with scripted deaths. Four legs:
+
+1. **kill mid-stream** — the stream's replica dies after first bytes
+   reached the client (re-emitting its last token at the seam): the
+   client stream continues seamlessly on the survivor, every index
+   exactly once, NO error frame, ``failover_count`` stamped on done;
+2. **kill during prefill** — the replica dies before any response
+   byte: the pre-first-byte re-route hides it entirely (no failover,
+   no error);
+3. **wedge -> stall-evict -> failover** — the replica stops producing
+   AND stops answering probes: the control loop evicts it, the relay
+   notices mid-poll, and the stream resumes on the survivor;
+4. **journal cap exceeded** — a stream past ``--failover-journal-
+   tokens`` loses protection: replica death yields the HONEST error
+   frame (the documented degradation), never a silent truncation.
+
+``--real`` adds the slow leg: a supervised fleet of two real
+``python -m tpunet.serve`` children with ``--chaos
+kill@tokens=N:replica=0`` (tpunet/serve/chaos.py) — SIGKILL of a real
+engine mid-stream, resumed through the real bucketed-prefill path.
+
+Wired into scripts/run_checks.sh (fast set; --slow adds --real).
+Exit 0 = all legs pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def stream_token(prompt0: int, i: int) -> int:
+    """The stubs' shared 'model': token ``i`` of a stream is a pure
+    function of the prompt (like two real replicas sharing weights),
+    so a resumed stub continues the same logical stream."""
+    return (prompt0 + 7 * (i + 1)) % 256
+
+
+class StubReplica:
+    """Stdlib stand-in for one tpunet.serve replica speaking the
+    streaming + resume contract. ``behavior`` keys:
+
+    - ``die_after_tokens``: close the socket abruptly after emitting
+      that many token lines (once; cleared after firing);
+    - ``dup_at_seam``: re-emit the last token line before dying (the
+      'replica emitted token N as it died' seam);
+    - ``die_at_prefill``: close the socket before any response byte
+      (once);
+    - ``wedge_after_tokens``: emit that many lines then hang — and
+      hang /healthz too (the wedged-process shape);
+    - ``resume_delay_s``: sleep before answering a resume (widens the
+      failover window for the drain-coordination test);
+    - ``line_delay_s``: sleep before each token line (a slow stream).
+
+    ``headers_seen`` records each generate request's headers (the
+    deadline-propagation test reads ``X-Deadline-Ms`` back).
+    """
+
+    def __init__(self, run_id: str, behavior=None):
+        self.run_id = run_id
+        self.behavior = dict(behavior or {})
+        self.requests = 0
+        self.resumes = 0
+        self.headers_seen = []
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+            def _json(self, code, obj, headers=()):
+                b = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(b)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(b)
+
+            def do_GET(self):  # noqa: N802
+                if stub.behavior.get("wedged"):
+                    time.sleep(30.0)      # probe times out -> evict
+                if self.path == "/healthz":
+                    self._json(200, {"status": "ok",
+                                     "run_id": stub.run_id,
+                                     "slots": 4, "queue_depth": 0,
+                                     "active_slots": 0})
+                else:
+                    self._json(200, {"serve_requests_total":
+                                     stub.requests})
+
+            def _chunk(self, obj):
+                line = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode()
+                                 + line + b"\r\n")
+                self.wfile.flush()
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                stub.requests += 1
+                stub.headers_seen.append(dict(self.headers))
+                if stub.behavior.pop("die_at_prefill", None):
+                    self.connection.close()
+                    return
+                prompt0 = int((body.get("tokens") or [0])[0])
+                resume = body.get("resume_tokens") or []
+                if resume:
+                    stub.resumes += 1
+                    delay = stub.behavior.get("resume_delay_s")
+                    if delay:
+                        time.sleep(delay)
+                budget = int(body.get("max_new_tokens", 8))
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                die_after = stub.behavior.get("die_after_tokens")
+                wedge_after = stub.behavior.get("wedge_after_tokens")
+                emitted = 0
+                for i in range(len(resume), budget):
+                    line_delay = stub.behavior.get("line_delay_s")
+                    if line_delay:
+                        time.sleep(line_delay)
+                    ev = {"token": stream_token(prompt0, i), "i": i}
+                    self._chunk(ev)
+                    emitted += 1
+                    if die_after is not None and emitted >= die_after:
+                        if stub.behavior.get("dup_at_seam"):
+                            self._chunk(ev)       # the seam duplicate
+                        stub.behavior.pop("die_after_tokens", None)
+                        self.connection.close()   # no done frame
+                        return
+                    if wedge_after is not None \
+                            and emitted >= wedge_after:
+                        stub.behavior["wedged"] = True
+                        time.sleep(60.0)          # never finishes
+                        return
+                self._chunk({"done": True, "finish_reason": "length",
+                             "n_tokens": budget})
+                self.wfile.write(b"0\r\n\r\n")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def read_stream(base, body, timeout=30, headers=()):
+    """POST a streaming generate and return the parsed ndjson lines."""
+    req = urllib.request.Request(
+        base + "/v1/generate", json.dumps(body).encode(),
+        {"Content-Type": "application/json", **dict(headers)})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return [json.loads(line) for line in resp]
+
+
+def wait_for(pred, timeout=20.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def make_router(stub_urls, **cfg_kw):
+    from tpunet.config import RouterConfig
+    from tpunet.router import Router, RouterServer
+    cfg_kw.setdefault("probe_interval_s", 0.1)
+    cfg_kw.setdefault("probe_timeout_s", 0.5)
+    cfg_kw.setdefault("unhealthy_after", 2)
+    cfg_kw.setdefault("boot_timeout_s", 2.0)
+    cfg_kw.setdefault("emit_every_s", 0.0)
+    cfg_kw.setdefault("affinity_prefix", 0)
+    router = Router(RouterConfig(**cfg_kw), replica_urls=stub_urls)
+    server = RouterServer(router, port=0).start()
+    return router, server
+
+
+def expected_tokens(prompt0, n):
+    return [stream_token(prompt0, i) for i in range(n)]
+
+
+def leg_kill_mid_stream():
+    """Leg 1: SIGKILL-shaped death after first bytes (with the seam
+    duplicate) -> seamless continuation, every index exactly once."""
+    stubs = [StubReplica("c0", {"die_after_tokens": 3,
+                                "dup_at_seam": True}),
+             StubReplica("c1")]
+    router, server = make_router([s.url for s in stubs])
+    try:
+        wait_for(lambda: router.healthy_count() == 2, what="2 healthy")
+        lines = read_stream(f"http://127.0.0.1:{server.port}",
+                            {"tokens": [10], "max_new_tokens": 8,
+                             "stream": True})
+        done = lines[-1]
+        toks = [ev["token"] for ev in lines if "token" in ev]
+        assert done.get("done") and done["finish_reason"] == "length", \
+            f"expected clean done frame, got {done}"
+        assert "error" not in done, done
+        assert toks == expected_tokens(10, 8), \
+            f"stream diverged: {toks}"
+        assert [ev["i"] for ev in lines if "token" in ev] \
+            == list(range(8)), "indices not exactly-once"
+        assert done.get("failover_count") == 1, done
+        assert stubs[1].resumes == 1, "survivor never saw the resume"
+        snap = router.registry.snapshot()
+        assert snap.get("router_failovers_total", 0) >= 1, snap
+    finally:
+        server.drain()
+        for s in stubs:
+            s.close()
+
+
+def leg_kill_at_prefill():
+    """Leg 2: death before any response byte -> pre-first-byte
+    re-route, no failover machinery involved."""
+    stubs = [StubReplica("p0", {"die_at_prefill": True}),
+             StubReplica("p1")]
+    router, server = make_router([s.url for s in stubs])
+    try:
+        wait_for(lambda: router.healthy_count() == 2, what="2 healthy")
+        lines = read_stream(f"http://127.0.0.1:{server.port}",
+                            {"tokens": [20], "max_new_tokens": 6,
+                             "stream": True})
+        done = lines[-1]
+        toks = [ev["token"] for ev in lines if "token" in ev]
+        assert done.get("done") and done["finish_reason"] == "length"
+        assert toks == expected_tokens(20, 6)
+        assert "failover_count" not in done, \
+            "prefill death must re-route, not failover"
+        snap = router.registry.snapshot()
+        assert snap.get("router_rerouted_total", 0) >= 1
+    finally:
+        server.drain()
+        for s in stubs:
+            s.close()
+
+
+def leg_wedge_stall_evict():
+    """Leg 3: the replica wedges (stream AND probes stall) -> the
+    control loop evicts it, the relay's poll notices, the stream
+    resumes on the survivor."""
+    stubs = [StubReplica("w0", {"wedge_after_tokens": 2}),
+             StubReplica("w1")]
+    router, server = make_router([s.url for s in stubs])
+    try:
+        wait_for(lambda: router.healthy_count() == 2, what="2 healthy")
+        lines = read_stream(f"http://127.0.0.1:{server.port}",
+                            {"tokens": [30], "max_new_tokens": 6,
+                             "stream": True}, timeout=30)
+        done = lines[-1]
+        toks = [ev["token"] for ev in lines if "token" in ev]
+        assert done.get("done") and done["finish_reason"] == "length", \
+            done
+        assert toks == expected_tokens(30, 6), toks
+        assert done.get("failover_count") == 1, done
+        assert any(r.state in ("dead", "evicted")
+                   for r in router.replicas), \
+            "wedged replica was never evicted"
+    finally:
+        server.drain()
+        for s in stubs:
+            s.close()
+
+
+def leg_journal_cap():
+    """Leg 4: past the journal cap the stream loses protection —
+    replica death gets the HONEST error frame (the documented
+    degradation), never a silent truncation."""
+    stubs = [StubReplica("j0", {"die_after_tokens": 8}),
+             StubReplica("j1")]
+    router, server = make_router([s.url for s in stubs],
+                                 failover_journal_tokens=4)
+    try:
+        wait_for(lambda: router.healthy_count() == 2, what="2 healthy")
+        lines = read_stream(f"http://127.0.0.1:{server.port}",
+                            {"tokens": [40], "max_new_tokens": 16,
+                             "stream": True})
+        done = lines[-1]
+        assert done.get("done") and done["finish_reason"] == "error", \
+            f"over-cap death must be an honest error frame: {done}"
+        assert "journal cap" in done.get("error", ""), done
+        assert done["n_tokens"] == 4, done
+        assert stubs[1].resumes == 0, \
+            "over-cap stream must not attempt a resume"
+    finally:
+        server.drain()
+        for s in stubs:
+            s.close()
+
+
+def leg_real_engine():
+    """Slow leg (--real): two real serve children, --chaos
+    kill@tokens=N:replica=0 — a real SIGKILL of a real engine
+    mid-stream, resumed through the real bucketed-prefill path with
+    no error frame."""
+    import tempfile
+
+    from tpunet.router.__main__ import build_argparser, build_server
+    from tpunet.router.balance import preferred_replica
+    from tpunet.router.replica import ReplicaHandle
+
+    tmp = tempfile.mkdtemp(prefix="serve-chaos-")
+    argv = ["--spawn", "2", "--port", "0",
+            "--probe-interval-s", "0.2", "--probe-timeout-s", "2",
+            "--unhealthy-after", "2", "--boot-timeout-s", "240",
+            "--respawn-backoff-s", "60",   # victim stays down: the
+            #                               survivor must carry alone
+            "--emit-every-s", "0.5", "--min-replicas", "2",
+            "--max-replicas", "2", "--metrics-dir", tmp,
+            "--chaos", "kill@tokens=12:replica=0", "--",
+            "--checkpoint-dir", "", "--slots", "2",
+            "--prefill-buckets", "64", "--queue-max", "16",
+            "--max-new-tokens", "64", "--vit-hidden", "32",
+            "--vit-depth", "2", "--vit-heads", "2",
+            "--vocab-size", "256", "--max-seq-len", "256"]
+    server = build_server(build_argparser().parse_args(argv)).start()
+    router = server.router
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        wait_for(lambda: router.healthy_count() == 2, timeout=240,
+                 what="both replicas healthy (cold boot)")
+        # Pin the stream to the chaos-armed child via session
+        # affinity (rendezvous over replica names is pure).
+        fakes = [ReplicaHandle("r0", "http://x"),
+                 ReplicaHandle("r1", "http://x")]
+        session = next(s for s in (f"s{i}" for i in range(64))
+                       if preferred_replica(fakes, f"s:{s}").name
+                       == "r0")
+        lines = read_stream(base, {"tokens": [7, 3, 9],
+                                   "max_new_tokens": 24,
+                                   "stream": True,
+                                   "session": session}, timeout=240)
+        done = lines[-1]
+        toks = [ev["token"] for ev in lines if "token" in ev]
+        assert done.get("done") and done["finish_reason"] == "length", \
+            done
+        assert "error" not in done, done
+        assert len(toks) == 24, f"{len(toks)} tokens"
+        assert done.get("failover_count", 0) >= 1, done
+        snap = json.loads(urllib.request.urlopen(
+            base + "/metrics", timeout=10).read())
+        assert snap.get("router_failovers_total", 0) >= 1
+    finally:
+        server.drain()
+
+
+def main() -> int:
+    real = "--real" in sys.argv[1:]
+    unknown = [a for a in sys.argv[1:] if a != "--real"]
+    if unknown:
+        print(f"usage: serve_chaos_smoke.py [--real] "
+              f"(unknown: {unknown})", file=sys.stderr)
+        return 2
+    legs = [("kill mid-stream -> seamless continuation",
+             leg_kill_mid_stream),
+            ("kill during prefill -> pre-first-byte re-route",
+             leg_kill_at_prefill),
+            ("wedge -> stall-evict -> failover",
+             leg_wedge_stall_evict),
+            ("journal cap exceeded -> honest error frame",
+             leg_journal_cap)]
+    if real:
+        legs.append(("real engine: SIGKILL mid-stream, no error "
+                     "frame", leg_real_engine))
+    failures = []
+    for name, fn in legs:
+        try:
+            fn()
+            print(f"[PASS] {name}")
+        except Exception as e:  # noqa: BLE001
+            failures.append(name)
+            print(f"[FAIL] {name}: {type(e).__name__}: {e}")
+    if failures:
+        print(f"serve_chaos_smoke: FAILED ({', '.join(failures)})")
+        return 1
+    print("serve_chaos_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

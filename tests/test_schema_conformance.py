@@ -80,13 +80,15 @@ def test_router_records_emitted_and_rolled_up():
     checker = _import_checker()
     records = checker.collect_router_records()
     kinds = [r["kind"] for r in records]
-    assert kinds == ["obs_router"] * 5
+    assert kinds == ["obs_router"] * 6
     window = records[0]
     assert window["final"] and window["replicas"] == 2
     assert window["per_replica"][0]["state"] == "healthy"
     assert window["scale_decision"] == "scale_up"
+    assert window["failovers_total"] == 2
     events = {r.get("event") for r in records[1:]}
-    assert events == {"evict", "respawn", "scale_up", "scale_down"}
+    assert events == {"evict", "respawn", "scale_up", "scale_down",
+                      "failover"}
     # Identity stamps every record.
     assert all(r["run_id"] == "router-check" for r in records)
     rollups = [r for r in checker.collect_agg_records()

@@ -475,6 +475,12 @@ class ServeConfig:
     # (the persistent compilation cache still applies). Single-device
     # replicas only; ignored with --mesh-model > 1.
     aot_cache: str = ""
+    # Serve-tier fault injection (--chaos, tpunet/serve/chaos.py):
+    # deterministic SIGKILL/stall/probe-drop/slow-stream faults
+    # addressed by generated-token count or prefill ordinal —
+    # docs/serving.md "Mid-stream failover & serve-tier chaos". Empty
+    # = no injector installed.
+    chaos: str = ""
 
 
 @dataclass(frozen=True)
@@ -532,6 +538,27 @@ class RouterConfig:
     boot_timeout_s: float = 120.0
     # Backoff before respawning an evicted/dead replica child.
     respawn_backoff_s: float = 1.0
+    # Mid-stream failover (--failover / --no-failover, docs/serving.md
+    # "Mid-stream failover & serve-tier chaos"): the frontend journals
+    # every streamed /v1/generate request (prompt, sampling params,
+    # relayed tokens) and, when the serving replica dies mid-stream,
+    # re-submits to a survivor with ``resume_tokens`` — the client's
+    # ndjson stream continues with no error frame (greedy:
+    # token-identical; sampled: deterministic per (seed, step)).
+    failover: bool = True
+    # Per-request journal bound: a stream that has relayed more than
+    # this many tokens is no longer failover-protected (on replica
+    # death it gets the honest error frame — the documented
+    # degradation mode). Bounds router memory per in-flight stream.
+    failover_journal_tokens: int = 4096
+    # Resume attempts per request after a mid-stream replica death
+    # (each attempt picks a different surviving replica).
+    failover_retries: int = 2
+    # Serve-tier fault injection forwarded to spawned replicas
+    # (--chaos, tpunet/serve/chaos.py grammar plus a ``:replica=I``
+    # scope key naming the child index; unscoped events reach every
+    # child). Empty = no injection.
+    chaos: str = ""
     # Router identity on obs_router records (empty =
     # "router-<host>-<pid>").
     run_id: str = ""

@@ -19,9 +19,14 @@ fleet signals the obs subsystems already produce:
 - ``core``       — the Router: control loop (probe -> evict ->
   respawn -> scale -> emit), ``obs_router`` records, webhook-driven
   eviction (PR-9 ``AlertWebhook`` POSTs land on ``POST /webhook``).
+- ``journal``    — bounded in-memory journal of in-flight streamed
+  requests: the resume state mid-stream failover replays onto a
+  surviving replica (docs/serving.md "Mid-stream failover &
+  serve-tier chaos").
 - ``frontend``   — stdlib threaded HTTP proxy: ``/v1/generate``
-  (streaming and blocking), ``/v1/classify``, ``/healthz``,
-  ``/metrics``, ``/replicas``, ``/webhook``.
+  (streaming and blocking, with mid-stream failover),
+  ``/v1/classify``, ``/healthz``, ``/metrics``, ``/replicas``,
+  ``/webhook``.
 
 Cold-start is the autoscaling unlock: replicas boot with
 ``--aot-cache`` (tpunet/utils/cache.py ``AotProgramStore``) so a
@@ -35,13 +40,14 @@ Entry point: ``python -m tpunet.router`` (docs/serving.md
 from tpunet.router.balance import affinity_key, pick_replica
 from tpunet.router.core import Router
 from tpunet.router.frontend import RouterServer
+from tpunet.router.journal import RequestJournal
 from tpunet.router.policy import AutoscalePolicy
 from tpunet.router.records import build_router_record
 from tpunet.router.replica import ReplicaHandle
 from tpunet.router.supervisor import Supervisor
 
 __all__ = [
-    "AutoscalePolicy", "ReplicaHandle", "Router", "RouterServer",
-    "Supervisor", "affinity_key", "build_router_record",
-    "pick_replica",
+    "AutoscalePolicy", "ReplicaHandle", "RequestJournal", "Router",
+    "RouterServer", "Supervisor", "affinity_key",
+    "build_router_record", "pick_replica",
 ]

@@ -78,6 +78,29 @@ def build_argparser():
     p.add_argument("--route-retries", type=int, default=d.route_retries,
                    help="re-route attempts when a replica fails "
                         "before any response byte was relayed")
+    p.add_argument("--failover", default=d.failover,
+                   action=argparse.BooleanOptionalAction,
+                   help="mid-stream failover (default on): journal "
+                        "streamed tokens and resume a dying stream "
+                        "on a surviving replica with no error frame; "
+                        "--no-failover restores the honest-error-"
+                        "frame-and-client-retry behavior")
+    p.add_argument("--failover-journal-tokens", type=int,
+                   default=d.failover_journal_tokens,
+                   help="per-stream journal bound: past this many "
+                        "relayed tokens a stream is no longer "
+                        "failover-protected (replica death then gets "
+                        "the honest error frame)")
+    p.add_argument("--failover-retries", type=int,
+                   default=d.failover_retries,
+                   help="resume attempts per request after mid-"
+                        "stream replica deaths")
+    p.add_argument("--chaos", default=d.chaos, metavar="SPEC",
+                   help="serve-tier fault injection forwarded to "
+                        "spawned replicas (tpunet/serve/chaos.py "
+                        "grammar + ':replica=I' scope; unscoped "
+                        "events reach every child) — the failover "
+                        "matrix scripts/serve_chaos_smoke.py runs on")
     p.add_argument("--request-timeout-s", type=float,
                    default=d.request_timeout_s)
     p.add_argument("--emit-every-s", type=float, default=d.emit_every_s,
@@ -154,6 +177,10 @@ def build_router_config(args):
         ttft_slo_ms=args.ttft_slo_ms,
         drain_grace_s=args.drain_grace_s,
         respawn_backoff_s=args.respawn_backoff_s,
+        failover=args.failover,
+        failover_journal_tokens=args.failover_journal_tokens,
+        failover_retries=args.failover_retries,
+        chaos=args.chaos,
         run_id=args.run_id)
 
 
@@ -172,6 +199,16 @@ def build_server(args):
               "give --replica URL (repeatable) and/or --spawn N",
               file=sys.stderr, flush=True)
         raise SystemExit(2)
+    if args.chaos:
+        # A typo'd chaos spec is a loud exit-2 at router boot, not a
+        # child-boot failure minutes later.
+        from tpunet.serve.chaos import ServeChaosError, split_by_replica
+        try:
+            split_by_replica(args.chaos)
+        except ServeChaosError as e:
+            print(f"python -m tpunet.router: error: {e}",
+                  file=sys.stderr, flush=True)
+            raise SystemExit(2)
     serve_args = list(args.serve_args)
     if serve_args and serve_args[0] == "--":
         serve_args = serve_args[1:]
@@ -180,7 +217,7 @@ def build_server(args):
         supervisor = Supervisor(
             serve_args, directory=args.metrics_dir,
             drain_grace_s=cfg.drain_grace_s,
-            aot_cache=args.aot_cache)
+            aot_cache=args.aot_cache, chaos=args.chaos)
     registry = Registry()
     recorder = None
     metrics_logger = None

@@ -31,6 +31,7 @@ from tpunet.obs import flightrec
 from tpunet.obs.registry import Registry
 from tpunet.router import replica as rstate
 from tpunet.router.balance import affinity_key, pick_replica
+from tpunet.router.journal import RequestJournal
 from tpunet.router.policy import SCALE_DOWN, SCALE_UP, AutoscalePolicy
 from tpunet.router.records import (build_router_event,
                                    build_router_record)
@@ -64,6 +65,11 @@ class Router:
                 process_index=0, host=socket.gethostname())
         self._clock = clock
         self.policy = AutoscalePolicy(cfg, clock=clock)
+        # Mid-stream failover journal (tpunet/router/journal.py):
+        # owned here so the drain path can wait for in-flight
+        # failovers instead of orphaning them with the frontend.
+        self.journal = RequestJournal(
+            getattr(cfg, "failover_journal_tokens", 4096))
         self.replicas: List[ReplicaHandle] = []
         self._boot_deadline: Dict[str, float] = {}
         self._respawn_at: Dict[str, float] = {}
@@ -142,6 +148,19 @@ class Router:
 
     def note_rejected(self) -> None:
         self.registry.counter("router_rejected_total").inc()
+
+    def note_failover(self, rep: ReplicaHandle, *,
+                      tokens: int) -> None:
+        """One mid-stream failover began: the stream's owner died (or
+        wedged into eviction) after ``tokens`` tokens reached the
+        client and a resume is being submitted to a survivor."""
+        self.registry.counter("router_failovers_total").inc()
+        flightrec.record("router",
+                         f"failover from {rep.name} at {tokens} tok")
+        self.registry.emit("obs_router", build_router_event(
+            "failover", replica=rep.name, url=rep.url,
+            cause="replica_failed_mid_stream",
+            detail={"tokens_relayed": tokens}))
 
     def observe_e2e(self, seconds: float) -> None:
         self.registry.histogram("router_e2e_s").observe(seconds)
@@ -398,11 +417,21 @@ class Router:
                 and self._thread.is_alive())
 
     def drain(self) -> None:
-        """Stop the control loop, flush the final record, drain every
-        supervised child."""
+        """Stop the control loop, wait out in-flight failovers, flush
+        the final record, drain every supervised child. The failover
+        wait and the children's graceful drain share ONE grace budget
+        (``drain_grace_s``): a journaled request mid-failover is not
+        orphaned, and a resumed stream is back in a replica's
+        in-flight set where the child's own drain finishes it."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        deadline = time.monotonic() + self.cfg.drain_grace_s
+        while self.journal.active_failovers() \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
         self.emit_record(final=True)
         if self.supervisor is not None:
-            self.supervisor.stop_all(drain=True)
+            self.supervisor.stop_all(
+                drain=True,
+                grace_s=max(0.0, deadline - time.monotonic()))

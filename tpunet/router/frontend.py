@@ -8,6 +8,13 @@ Endpoints:
   reached the client is retried against another replica (up to
   ``route_retries`` re-routes); client errors (400/413) relay
   immediately — re-routing a bad request just fails it N times.
+  Streams get MID-STREAM FAILOVER (``--failover``, default on): the
+  frontend journals every relayed token, and a replica that dies
+  after first bytes reached the client is replaced — the request is
+  re-submitted to a survivor with ``resume_tokens`` and the client's
+  stream continues with no error frame (greedy: token-identical to an
+  uninterrupted run; sampled: deterministic per (seed, step) — see
+  docs/serving.md "Mid-stream failover & serve-tier chaos").
 - ``POST /v1/classify`` — same proxy, no affinity (stateless).
 - ``POST /webhook`` — AlertWebhook receiver: straggler / crash /
   thread_stalled pages naming a replica's run_id evict it
@@ -19,12 +26,19 @@ Endpoints:
 - ``GET /replicas`` — per-replica state/load/counters (the e2e tests
   and ``bench_serve --router`` read replica request counts here).
 
+Client deadline propagation: an ``X-Deadline-Ms`` request header is
+honored end-to-end — every hop (including failover retries) forwards
+the REMAINING budget to the replica, and expiry before success is a
+504 carrying the partial token count (mid-stream: a ``deadline`` done
+frame).
+
 With no routable replica the router answers 503 with ``Retry-After:
 1`` — the same backpressure contract the replicas themselves speak.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import time
@@ -34,8 +48,90 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from tpunet.obs import flightrec
+from tpunet.router import replica as rstate
 from tpunet.router.core import Router
 from tpunet.serve import httpjson
+
+#: Relay poll period while a stream is quiet: bounds how long a
+#: wedged-but-connected replica can hold a stream before the relay
+#: notices its eviction (the stall-evict -> failover path).
+_STREAM_POLL_S = 0.5
+
+
+class _StreamReader:
+    """Line reader for one upstream response on its own thread.
+
+    http.client response objects cannot be poll-read: a socket
+    timeout permanently poisons them ("cannot read from timed out
+    object"), so the relay blocks a dedicated reader in
+    ``readline()`` and polls its queue instead — replica-eviction and
+    deadline checks run between polls, and abandoning a wedged stream
+    is just closing the response (the blocked read unblocks and the
+    thread exits)."""
+
+    _registered = False
+
+    def __init__(self, resp):
+        import queue
+        self._resp = resp
+        self._q: "queue.Queue" = queue.Queue()
+        self._empty = queue.Empty
+        # One inventory-only registration (stall budget 0) for the
+        # whole relay-reader population, like the router-http
+        # listener: readers legitimately block in readline for a
+        # stream's lifetime, and per-stream handles would leave a
+        # stale never-beating entry per request in the process-global
+        # registry.
+        if not _StreamReader._registered:
+            _StreamReader._registered = True
+            flightrec.register_thread("router-relay")
+        self._thread = threading.Thread(
+            target=self._run, args=(resp,), daemon=True,
+            name="tpunet-router-relay")
+        self._thread.start()
+
+    def _run(self, resp) -> None:
+        try:
+            while True:
+                line = resp.readline()
+                self._q.put(("line", line))
+                if not line:
+                    return
+        except Exception as e:  # noqa: BLE001 — any read failure is
+            # the same relay signal: the stream is over.
+            self._q.put(("exc", e))
+
+    def get(self, timeout: float):
+        """("line", bytes) / ("exc", exception) / None on poll
+        timeout. A b"" line is upstream EOF."""
+        try:
+            return self._q.get(timeout=timeout)
+        except self._empty:
+            return None
+
+    def close(self) -> None:
+        """Tear the stream down even when the reader is still blocked
+        mid-readline (a wedged replica): ``resp.close()`` alone would
+        deadlock on the buffered reader's lock, so the SOCKET is shut
+        down first — the blocked recv returns EOF, the thread exits,
+        and only then is the response closed. A reader that still
+        won't die keeps its response leaked (daemon thread) rather
+        than deadlocking the relay."""
+        import socket
+        sock = getattr(getattr(self._resp, "fp", None), "raw", None)
+        sock = getattr(sock, "_sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)
+        if self._thread.is_alive():
+            return
+        try:
+            self._resp.close()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class RouterServer:
@@ -70,7 +166,10 @@ class RouterServer:
 
     def drain(self) -> None:
         """Stop listening, stop the control loop, drain supervised
-        children, flush sinks. Idempotent."""
+        children, flush sinks. Idempotent. In-flight failovers are
+        waited for inside ``Router.drain`` against the shared grace
+        budget — the journal is never orphaned with its frontend
+        thread."""
         if self._drained:
             return
         self._drained = True
@@ -108,6 +207,32 @@ def _make_handler(server: RouterServer):
         def _read_body(self) -> dict:
             return httpjson.read_json_body(self)
 
+        def _client_deadline(self) -> Optional[float]:
+            """Absolute monotonic deadline from the client's
+            ``X-Deadline-Ms`` header (None when absent; raises
+            ValueError on garbage)."""
+            hdr = self.headers.get("X-Deadline-Ms")
+            if hdr is None:
+                return None
+            ms = float(hdr)               # ValueError -> 400
+            if ms <= 0:
+                raise ValueError(
+                    f"X-Deadline-Ms must be positive, got {hdr!r}")
+            return time.monotonic() + ms / 1e3
+
+        @staticmethod
+        def _replica_headers(deadline_t: Optional[float]) -> dict:
+            """Headers for one replica-bound request: the remaining
+            deadline budget rides along so the engine's scheduler
+            enforces the CLIENT's clock, and a failover retry can
+            never exceed the original budget."""
+            headers = {"Content-Type": "application/json"}
+            if deadline_t is not None:
+                remaining = max(1.0,
+                                1e3 * (deadline_t - time.monotonic()))
+                headers["X-Deadline-Ms"] = f"{remaining:.0f}"
+            return headers
+
         # -- GET -------------------------------------------------------
 
         def do_GET(self):  # noqa: N802 (stdlib handler API)
@@ -141,9 +266,12 @@ def _make_handler(server: RouterServer):
                 self._json(400, {"error": str(e)})
                 return
             if self.path == "/v1/generate":
-                self._proxy(body, "/v1/generate",
-                            stream=bool(body.get("stream")),
-                            affine=True)
+                if body.get("stream") and cfg.failover:
+                    self._generate_stream(body)
+                else:
+                    self._proxy(body, "/v1/generate",
+                                stream=bool(body.get("stream")),
+                                affine=True)
             elif self.path == "/v1/classify":
                 self._proxy(body, "/v1/classify", stream=False,
                             affine=False)
@@ -153,22 +281,35 @@ def _make_handler(server: RouterServer):
             else:
                 self._json(404, {"error": "not found"})
 
-        # -- proxying --------------------------------------------------
+        # -- replica connection (pre-first-byte retry loop) ------------
 
-        def _proxy(self, body: dict, path: str, *, stream: bool,
-                   affine: bool) -> None:
+        def _open_on_fleet(self, body: dict, path: str, tried: set,
+                           *, affine: bool,
+                           deadline_t: Optional[float]):
+            """Pick a replica and open the request, re-routing around
+            dead/draining replicas BEFORE any response byte exists.
+            Returns one of::
+
+                ("resp", resp, rep)        connection open, routed
+                ("relay", code, payload)   live replica's own error —
+                                           relay verbatim
+                ("reject", code, payload, headers)
+                                           exhausted / expired
+            """
             raw = json.dumps(body).encode()
-            t0 = time.perf_counter()
-            tried = set()
             last_error = None
             for _ in range(cfg.route_retries + 1):
+                if deadline_t is not None \
+                        and time.monotonic() >= deadline_t:
+                    return ("reject", 504,
+                            {"error": "deadline", "n_tokens": 0}, ())
                 rep, _hit = (router.pick(body, exclude=tried) if affine
                              else router.pick({}, exclude=tried))
                 if rep is None:
                     break
                 req = urllib.request.Request(
                     rep.url + path, raw,
-                    {"Content-Type": "application/json"})
+                    self._replica_headers(deadline_t))
                 try:
                     resp = urllib.request.urlopen(
                         req, timeout=cfg.request_timeout_s)
@@ -184,8 +325,9 @@ def _make_handler(server: RouterServer):
                         e.close()
                         tried.add(rep.name)
                         router.note_rerouted(rep)
-                        last_error = (e.code, {"error": "replica_busy",
-                                               "replica": rep.name})
+                        last_error = (e.code,
+                                      {"error": "replica_busy",
+                                       "replica": rep.name})
                         continue
                     # Client/server error from a live replica: relay
                     # verbatim (re-routing a 400 fails it N times).
@@ -193,10 +335,10 @@ def _make_handler(server: RouterServer):
                     try:
                         payload = json.loads(e.read())
                     except Exception:  # noqa: BLE001
-                        payload = {"error": f"replica returned {e.code}"}
+                        payload = {"error":
+                                   f"replica returned {e.code}"}
                     e.close()
-                    self._json(e.code, payload)
-                    return
+                    return ("relay", e.code, payload)
                 except Exception:  # noqa: BLE001 — connection refused/
                     # reset/timeout: the replica is gone; probe it off-
                     # cadence and try another.
@@ -207,60 +349,299 @@ def _make_handler(server: RouterServer):
                                         "replica": rep.name})
                     continue
                 router.note_routed(rep)
-                try:
-                    if stream:
-                        self._relay_stream(resp)
-                    else:
-                        self._relay_json(resp)
-                finally:
-                    resp.close()
-                    router.observe_e2e(time.perf_counter() - t0)
-                return
+                return ("resp", resp, rep)
             router.note_rejected()
             code, payload = last_error or (
                 503, {"error": "no_replicas",
                       "detail": "no routable replica"})
-            self._json(code, payload,
-                       headers=(("Retry-After", "1"),))
+            return ("reject", code, payload, (("Retry-After", "1"),))
 
-        def _relay_json(self, resp) -> None:
-            payload = resp.read()
-            self.send_response(resp.status)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
+        # -- non-stream proxying ---------------------------------------
+
+        def _proxy(self, body: dict, path: str, *, stream: bool,
+                   affine: bool) -> None:
+            t0 = time.perf_counter()
+            try:
+                deadline_t = self._client_deadline()
+            except ValueError as e:
+                self._json(400, {"error": str(e)})
+                return
+            tried: set = set()
+            while True:
+                opened = self._open_on_fleet(body, path, tried,
+                                             affine=affine,
+                                             deadline_t=deadline_t)
+                if opened[0] == "relay":
+                    _, code, payload = opened
+                    self._json(code, payload)
+                    return
+                if opened[0] == "reject":
+                    _, code, payload, headers = opened
+                    self._json(code, payload, headers=headers)
+                    return
+                _, resp, rep = opened
+                if stream:
+                    # Legacy (--no-failover) stream relay: a replica
+                    # death mid-stream ends the stream with an honest
+                    # error frame and the client retries.
+                    try:
+                        self._relay_stream(resp)
+                    finally:
+                        resp.close()
+                        router.observe_e2e(time.perf_counter() - t0)
+                    return
+                # Non-stream: buffer the WHOLE body before the first
+                # client byte — a replica death mid-read is then fully
+                # retryable on another replica (nothing was sent, and
+                # generation is deterministic per (seed, step)).
+                try:
+                    payload = resp.read()
+                    status = resp.status
+                except (OSError, http.client.HTTPException):
+                    resp.close()
+                    tried.add(rep.name)
+                    router.note_rerouted(rep)
+                    router.replica_failed(rep)
+                    continue
+                resp.close()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                router.observe_e2e(time.perf_counter() - t0)
+                return
+
+        # -- streaming with mid-stream failover ------------------------
+
+        def _chunk(self, data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode()
+                             + data + b"\r\n")
+            self.wfile.flush()
+
+        def _finish_frame(self, entry, reason: str,
+                          error: Optional[str] = None) -> None:
+            """Terminate the client stream with a router-authored done
+            frame (degradation paths: journal cap, retries exhausted,
+            deadline). Client disconnects are swallowed — there is
+            nobody left to tell."""
+            frame = {"done": True, "finish_reason": reason,
+                     "n_tokens": len(entry.tokens)}
+            if entry.failover_count:
+                frame["failover_count"] = entry.failover_count
+            if error:
+                frame["error"] = error
+            try:
+                self._chunk((json.dumps(frame) + "\n").encode())
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+        def _generate_stream(self, body: dict) -> None:
+            """Streamed /v1/generate with mid-stream failover: journal
+            every relayed token; on replica death after first bytes,
+            resume on a survivor via ``resume_tokens`` — the client
+            stream continues with no error frame. Degradations (all
+            end in an honest frame, never a silent truncation):
+            journal over cap, failover retries exhausted, no surviving
+            replica, resume rejected, deadline expiry."""
+            t0 = time.perf_counter()
+            try:
+                deadline_t = self._client_deadline()
+            except ValueError as e:
+                self._json(400, {"error": str(e)})
+                return
+            entry = router.journal.open(body, deadline_t)
+            try:
+                tried: set = set()
+                opened = self._open_on_fleet(body, "/v1/generate",
+                                             tried, affine=True,
+                                             deadline_t=deadline_t)
+                if opened[0] == "relay":
+                    self._json(opened[1], opened[2])
+                    return
+                if opened[0] == "reject":
+                    self._json(opened[1], opened[2],
+                               headers=opened[3])
+                    return
+                _, resp, rep = opened
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while True:
+                    outcome = self._relay_journal_stream(entry, resp,
+                                                         rep)
+                    resp.close()
+                    if outcome == "done":
+                        router.observe_e2e(time.perf_counter() - t0)
+                        return
+                    if outcome == "client_gone":
+                        flightrec.record(
+                            "router", "client gone mid-stream")
+                        return
+                    if outcome == "deadline":
+                        self._finish_frame(entry, "deadline")
+                        return
+                    # outcome == "failed": the serving replica died
+                    # (or wedged into eviction) mid-stream. This is a
+                    # FAILOVER, not a pre-first-byte re-route —
+                    # router_failovers_total (note_failover below) is
+                    # its counter; only the per-replica failure
+                    # accounting rides here.
+                    tried.add(rep.name)
+                    rep.note_failed()
+                    router.replica_failed(rep)
+                    if entry.over_cap:
+                        self._finish_frame(
+                            entry, "error",
+                            "replica failed mid-stream past the "
+                            f"failover journal cap "
+                            f"({router.journal.max_tokens} tokens); "
+                            "retry the request")
+                        return
+                    if entry.failover_count >= cfg.failover_retries:
+                        self._finish_frame(
+                            entry, "error",
+                            "replica failed mid-stream and the "
+                            f"failover budget "
+                            f"({cfg.failover_retries}) is exhausted")
+                        return
+                    if deadline_t is not None \
+                            and time.monotonic() >= deadline_t:
+                        self._finish_frame(entry, "deadline")
+                        return
+                    router.journal.begin_failover(entry)
+                    router.note_failover(rep,
+                                         tokens=len(entry.tokens))
+                    opened = self._open_on_fleet(
+                        entry.resume_body(), "/v1/generate", tried,
+                        affine=True, deadline_t=deadline_t)
+                    if opened[0] != "resp":
+                        router.journal.end_failover(entry)
+                        detail = opened[2]
+                        reason = ("deadline"
+                                  if detail.get("error") == "deadline"
+                                  else "error")
+                        self._finish_frame(
+                            entry, reason,
+                            None if reason == "deadline" else
+                            "replica failed mid-stream and no "
+                            f"survivor could resume: {detail}")
+                        return
+                    _, resp, rep = opened
+                    # Resumed stream open: the request is in-flight on
+                    # the survivor again (a graceful drain now covers
+                    # it), so the failover window closes here.
+                    router.journal.end_failover(entry)
+            finally:
+                router.journal.close(entry)
+
+        def _relay_journal_stream(self, entry, resp, rep) -> str:
+            """Relay one replica's ndjson stream, journaling every
+            token. Returns ``done`` (final frame relayed), ``failed``
+            (replica died / wedged-evicted / torn line — failover
+            decision is the caller's), ``deadline`` (client budget
+            expired while the stream was quiet), or ``client_gone``.
+
+            Duplicate suppression at the kill seam: token events carry
+            their index in the generated sequence (``i``, falling back
+            to arrival order); an index below the journal length was
+            already relayed by the previous replica — e.g. the token
+            it emitted as it died — and is dropped, so the client sees
+            every index exactly once."""
+            reader = _StreamReader(resp)
+            try:
+                return self._relay_lines(entry, reader, rep)
+            finally:
+                reader.close()
+
+        def _relay_lines(self, entry, reader, rep) -> str:
+            base = len(entry.tokens)
+            seen = 0
+            while True:
+                got = reader.get(_STREAM_POLL_S)
+                if got is None:           # stream quiet: poll state
+                    if rep.state in (rstate.DEAD, rstate.EVICTED):
+                        flightrec.record(
+                            "router",
+                            f"stream owner {rep.name} evicted "
+                            "mid-relay")
+                        return "failed"
+                    remaining = entry.remaining_ms()
+                    if remaining is not None and remaining <= 0:
+                        return "deadline"
+                    continue
+                kind, line = got
+                if kind == "exc":
+                    # Socket reset OR chunked framing cut mid-chunk
+                    # (IncompleteRead) — both are the replica dying.
+                    flightrec.record("router", "stream relay broke")
+                    return "failed"
+                if not line:
+                    # EOF without a done frame: the replica's frontend
+                    # died between tokens.
+                    return "failed"
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    # Torn line at the death seam: never relay bytes
+                    # the journal cannot account for.
+                    return "failed"
+                if "token" in ev:
+                    idx = ev.get("i")
+                    if idx is None:
+                        idx = base + seen
+                    seen += 1
+                    if idx < len(entry.tokens):
+                        continue           # duplicate: suppress
+                    router.journal.note_token(entry, ev["token"])
+                    try:
+                        self._chunk(line)
+                    except OSError:
+                        return "client_gone"
+                    continue
+                if ev.get("done"):
+                    if entry.failover_count:
+                        ev["failover_count"] = entry.failover_count
+                        line = (json.dumps(ev) + "\n").encode()
+                    try:
+                        self._chunk(line)
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        return "client_gone"
+                    return "done"
+                # Unknown frame kinds relay verbatim (forward compat).
+                try:
+                    self._chunk(line)
+                except OSError:
+                    return "client_gone"
 
         def _relay_stream(self, resp) -> None:
-            """Relay replica ndjson chunk-by-chunk (urllib de-chunks
-            the replica side; we re-chunk toward the client). A
-            replica death mid-stream ends the stream with an error
-            done-frame — tokens already forwarded cannot be unsent,
-            so mid-stream failover is a non-goal; the client retries
-            and lands on a live replica."""
+            """Legacy (--no-failover) relay: replica ndjson chunk-by-
+            chunk (urllib de-chunks the replica side; we re-chunk
+            toward the client). A replica death mid-stream ends the
+            stream with an error done-frame — tokens already forwarded
+            cannot be unsent; the client retries and lands on a live
+            replica."""
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
-
-            def chunk(data: bytes) -> None:
-                self.wfile.write(f"{len(data):x}\r\n".encode()
-                                 + data + b"\r\n")
-                self.wfile.flush()
-
             try:
                 for line in resp:
-                    chunk(line)
+                    self._chunk(line)
                 self.wfile.write(b"0\r\n\r\n")
             except (BrokenPipeError, ConnectionResetError):
                 raise
-            except OSError:
+            except (OSError, http.client.HTTPException):
                 # Replica-side failure mid-relay: close the stream
                 # honestly (the flight recorder notes it; the done
                 # frame says error, not length).
                 flightrec.record("router", "stream relay broke")
                 try:
-                    chunk(json.dumps(
+                    self._chunk(json.dumps(
                         {"done": True, "finish_reason": "error",
                          "error": "replica failed mid-stream"})
                         .encode() + b"\n")

@@ -9,9 +9,9 @@ the kind:
   state: cumulative counters + window histograms + per-replica rows.
   No ``event`` field; they never page.
 - **event records** (``build_router_event``) — one per action the
-  control loop takes (evict / respawn / scale_up / scale_down /
-  drain_restart). These carry ``event`` and DO page through the
-  alert webhook (tpunet/obs/export/webhook.py).
+  control loop (or the failover relay) takes (evict / respawn /
+  scale_up / scale_down / failover). These carry ``event`` and DO
+  page through the alert webhook (tpunet/obs/export/webhook.py).
 """
 
 from __future__ import annotations
@@ -47,8 +47,8 @@ def build_router_record(reg, *, replicas: List[dict], uptime_s: float,
         "scale_decision": scale_decision,
     }
     for name in ("requests", "rerouted", "rejected", "affinity_hits",
-                 "evictions", "respawns", "scale_ups", "scale_downs",
-                 "probe_failures"):
+                 "failovers", "evictions", "respawns", "scale_ups",
+                 "scale_downs", "probe_failures"):
         record[f"{name}_total"] = int(
             reg.counter(f"router_{name}_total").value)
     if ttft_slo_burn is not None:

@@ -74,13 +74,18 @@ class Supervisor:
     def __init__(self, serve_args: List[str], *, directory: str = "",
                  host: str = "127.0.0.1", drain_grace_s: float = 30.0,
                  run_prefix: str = "router-replica",
-                 aot_cache: str = ""):
+                 aot_cache: str = "", chaos: str = ""):
         self.serve_args = list(serve_args)
         self.directory = directory
         self.host = host
         self.drain_grace_s = drain_grace_s
         self.run_prefix = run_prefix
         self.aot_cache = aot_cache
+        # Router-level chaos spec (tpunet/serve/chaos.py grammar plus
+        # the ``replica=I`` scope key): each child is launched with
+        # exactly the events that address its index. A respawned
+        # child re-arms its events — its counters restart with it.
+        self.chaos = chaos
         self.spawned_total = 0
         self._procs: Dict[int, ReplicaProcess] = {}
         # Inventory-only registration (stall budget 0): the supervisor
@@ -97,6 +102,11 @@ class Supervisor:
                      os.path.join(self.directory, f"replica-{index}")]
         if self.aot_cache and "--aot-cache" not in self.serve_args:
             argv += ["--aot-cache", self.aot_cache]
+        if self.chaos and "--chaos" not in self.serve_args:
+            from tpunet.serve.chaos import spec_for_replica
+            spec = spec_for_replica(self.chaos, index)
+            if spec:
+                argv += ["--chaos", spec]
         return argv + self.serve_args
 
     def spawn(self, index: int,
@@ -175,18 +185,23 @@ class Supervisor:
         self.kill(index)
         return self.spawn(index)
 
-    def stop_all(self, *, drain: bool = True) -> None:
+    def stop_all(self, *, drain: bool = True,
+                 grace_s: Optional[float] = None) -> None:
         """Stop every child against ONE shared grace budget: SIGTERM
         them all first, then wait — shutdown latency is one drain,
-        not N sequential ones."""
+        not N sequential ones. ``grace_s`` overrides the budget (the
+        router's drain passes what remains after waiting out in-flight
+        failovers, so the whole shutdown honors ``drain_grace_s``
+        once)."""
+        grace = self.drain_grace_s if grace_s is None else grace_s
         alive = [r for r in self._procs.values() if r.alive()]
-        if drain and self.drain_grace_s > 0:
+        if drain and grace > 0:
             for record in alive:
                 try:
                     record.proc.send_signal(signal.SIGTERM)
                 except OSError:
                     pass
-            deadline = time.monotonic() + self.drain_grace_s
+            deadline = time.monotonic() + grace
             for record in alive:
                 remaining = deadline - time.monotonic()
                 if remaining > 0:

@@ -141,6 +141,12 @@ def build_argparser():
                    help="replica identity stamped on obs_serve records "
                         "(fleet rollups route by it; default "
                         "serve-<host>-<pid>)")
+    p.add_argument("--chaos", default=d.chaos, metavar="SPEC",
+                   help="serve-tier fault injection (tpunet/serve/"
+                        "chaos.py): kill@tokens=N, kill@prefill[=K], "
+                        "stall@tokens=N:ms=M, drop-probe@prob=P:"
+                        "seed=X, slow-stream@ms=M — deterministic, "
+                        "';'-separated; docs/serving.md grammar")
     p.add_argument("--aot-cache", default=d.aot_cache, metavar="DIR",
                    help="AOT warm-start: serialize the compiled decode"
                         " + prefill executables under DIR on first "
@@ -182,6 +188,14 @@ def build_server(args):
     # after a runtime import.
     buckets = parse_prefill_buckets(args.prefill_buckets,
                                     args.max_seq_len)
+    if args.chaos:
+        # Same posture as the bucket list: a typo'd chaos spec is a
+        # loud exit-2 BEFORE the model loads, not a mid-serve raise.
+        from tpunet.serve.chaos import ServeChaos, ServeChaosError
+        try:
+            ServeChaos.parse(args.chaos)
+        except ServeChaosError as e:
+            raise _usage(str(e))
 
     import dataclasses
 
@@ -214,7 +228,8 @@ def build_server(args):
         classify_window_ms=args.classify_window_ms,
         emit_every_s=args.emit_every_s,
         drain_timeout_s=args.drain_timeout_s,
-        run_id=args.run_id, aot_cache=args.aot_cache)
+        run_id=args.run_id, aot_cache=args.aot_cache,
+        chaos=args.chaos)
     model_cfg = ModelConfig(
         name=args.model, vit_hidden=args.vit_hidden,
         vit_depth=args.vit_depth, vit_heads=args.vit_heads,

@@ -275,6 +275,11 @@ class Engine:
         self._admit_seq = 0
         self.peak_active_slots = 0   # high-water mark (bench_serve
         #                              --slots-sweep admitted-slot count)
+        # Serve-tier fault injector (--chaos, tpunet/serve/chaos.py):
+        # the engine fires token/prefill/stall hooks, the HTTP
+        # frontend the probe/stream ones. None when unarmed.
+        from tpunet.serve import chaos as serve_chaos
+        self.chaos = serve_chaos.install(getattr(cfg, "chaos", ""))
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._draining = threading.Event()
@@ -620,7 +625,10 @@ class Engine:
         req.requested_max_new_tokens = requested
         try:
             n = int(req.prompt.size)
-            self.bucket_for(n)  # raises PromptTooLongError
+            # A cross-replica resume (router failover) re-prefills
+            # prompt PLUS the journaled tokens: the combined length
+            # must fit a bucket, like any preempt-resume.
+            self.bucket_for(n + req.resume_offset)
             if n + req.max_new_tokens > self.max_seq_len:
                 req.max_new_tokens = self.max_seq_len - n
                 if req.max_new_tokens < 1:
@@ -638,6 +646,40 @@ class Engine:
                         f"length but the pool has "
                         f"{self.kv_pages_usable}; lower "
                         "max_new_tokens or grow --kv-pages")
+            if req.resume_offset and req.temperature > 0 \
+                    and not self.device_sampling:
+                # The sampled-continuation determinism guarantee rests
+                # on the device sampler's counter-based (seed, step)
+                # keys. The host sampler draws from a STATEFUL
+                # generator — a resume would restart it at draw 0 and
+                # diverge from the uninterrupted stream. Reject loudly
+                # (the router degrades to the honest error frame)
+                # rather than continue wrong.
+                raise ValueError(
+                    "sampled resume_tokens require device-side "
+                    "sampling (counter-based per-(seed, step) keys); "
+                    "this replica runs --no-device-sampling")
+            if req.resume_offset and req.stop_token is not None \
+                    and req.stop_token in req.tokens:
+                # The journal already contains the stop token: the
+                # donor died between streaming it and the done frame.
+                # An uninterrupted run stops THERE — finish as 'stop'
+                # without a slot, never generate past it.
+                req.finish(FINISH_STOP)
+                self._account_finish(req, FINISH_STOP)
+                self.registry.counter("serve_requests_total").inc()
+                return req
+            if req.resume_offset \
+                    and req.resume_offset >= req.max_new_tokens:
+                # Mid-stream-failover resume whose journal already
+                # meets the (possibly clamped) budget: the donor
+                # replica died between its last token and the done
+                # frame. Nothing to decode — finish as length without
+                # ever taking a slot.
+                req.finish(FINISH_LENGTH)
+                self._account_finish(req, FINISH_LENGTH)
+                self.registry.counter("serve_requests_total").inc()
+                return req
             self.queue.submit(req)       # may raise QueueFull/Draining
         except Exception:
             self.registry.counter("serve_requests_rejected").inc()
@@ -774,6 +816,8 @@ class Engine:
             # with reason 'drain' (the shutdown took it, not a client).
             self._kill_survivors(FINISH_DRAIN)
             return False
+        if self.chaos is not None:
+            self.chaos.maybe_stall()    # wedged-replica injection
         self._reap()
         admitted = self._admit()
         stepped = self._decode_iteration()
@@ -921,6 +965,8 @@ class Engine:
         from tpunet.obs import flightrec
         for _, req, _, _ in group:
             flightrec.record("req", f"prefill {req.id}")
+        if self.chaos is not None:
+            self.chaos.on_prefill()     # kill@prefill injection point
         with _ring_span("tpunet/serve_prefill"):
             if self.device_sampling:
                 self._cache, sampled = self._dispatch_step(
@@ -946,6 +992,9 @@ class Engine:
                 flightrec.record("req", f"first_token {req.id}")
                 reg.histogram("serve_ttft_s").observe(req.ttft_s)
             reg.counter("serve_tokens_total").inc()
+            if self.chaos is not None:
+                self.chaos.on_token()   # kill/stall@tokens (post-push:
+                #                         the token reached the stream)
             self._slot_maybe_finish(slot_i, first)
         reg.counter("serve_prefills_total").inc()
         reg.counter("serve_prefill_tokens_total").inc(
@@ -1030,6 +1079,8 @@ class Engine:
             slot.generated += 1
             slot.req.push_token(nxt)
             reg.counter("serve_tokens_total").inc()
+            if self.chaos is not None:
+                self.chaos.on_token()   # kill/stall@tokens (post-push)
             self._slot_maybe_finish(i, nxt)
         return True
 
@@ -1046,6 +1097,11 @@ class Engine:
             reg, queue_depth=self.queue.depth(),
             active_slots=self.active_slots(), slots=self.slots,
             uptime_s=now - self._started, window_s=window, final=final)
+        if self.chaos is not None:
+            # A record from a chaos-armed replica says so: bench and
+            # history comparisons must never mistake injected faults
+            # for organic regressions.
+            record["chaos"] = self.chaos.render()
         # Host-thread gauges ride the serve registry too: GET /metrics
         # and exporters see thread_* ages for the engine loop and any
         # exporter drains.

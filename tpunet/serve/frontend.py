@@ -157,6 +157,13 @@ def _make_handler(server: ServeServer):
         def do_GET(self):  # noqa: N802 (stdlib handler API)
             if self.path == "/healthz":
                 engine = server.engine
+                # Chaos injection (tpunet/serve/chaos.py): a standing
+                # stall wedges the probe (the router's stall-evict
+                # path); drop-probe answers 500 on the seeded draws.
+                if engine.chaos is not None \
+                        and engine.chaos.on_probe():
+                    self._json(500, {"error": "chaos: probe dropped"})
+                    return
                 run_id = server.registry.identity().get("run_id", "")
                 if engine.error is not None or not engine.healthy:
                     self._json(503, {
@@ -213,6 +220,36 @@ def _make_handler(server: ServeServer):
                     f"token ids outside [0, {server.vocab_size})")
             return toks
 
+        def _parse_resume(self, body: dict):
+            """``resume_tokens`` (router mid-stream failover): token
+            ids another replica already generated and streamed —
+            validated like a prompt, but allowed to be absent."""
+            if body.get("resume_tokens") is None:
+                return None
+            resume = np.asarray(body["resume_tokens"],
+                                np.int32).reshape(-1)
+            if resume.size and (resume.min() < 0
+                                or resume.max() >= server.vocab_size):
+                raise ValueError(
+                    f"resume_tokens ids outside "
+                    f"[0, {server.vocab_size})")
+            return resume.tolist()
+
+        def _deadline_s(self, body: dict) -> float:
+            """Effective wall-clock deadline: the ``X-Deadline-Ms``
+            header (the router propagates the client's original
+            budget through every failover hop) and the body's
+            ``deadline_s`` compose as the TIGHTER of the two."""
+            body_s = float(body.get("deadline_s", 0.0))
+            hdr = self.headers.get("X-Deadline-Ms")
+            if hdr is None:
+                return body_s
+            hdr_s = float(hdr) / 1e3
+            if hdr_s <= 0:
+                raise ValueError(
+                    f"X-Deadline-Ms must be positive, got {hdr!r}")
+            return min(body_s, hdr_s) if body_s > 0 else hdr_s
+
         def _generate(self, body: dict) -> None:
             try:
                 toks = self._parse_prompt(body)
@@ -222,13 +259,16 @@ def _make_handler(server: ServeServer):
                     # MISSING budget and rejects an invalid one (0 ->
                     # ValueError -> 400), never silently substitutes.
                     kw["max_new_tokens"] = int(body["max_new_tokens"])
+                resume = self._parse_resume(body)
+                if resume is not None:
+                    kw["resume_tokens"] = resume
                 req = server.engine.submit(
                     toks, **kw,
                     temperature=float(body.get("temperature", 0.0)),
                     top_k=int(body.get("top_k", 0)),
                     top_p=float(body.get("top_p", 0.0)),
                     seed=int(body.get("seed", 0)),
-                    deadline_s=float(body.get("deadline_s", 0.0)),
+                    deadline_s=self._deadline_s(body),
                     stop_token=int(body["stop_token"])
                     if body.get("stop_token") is not None else None)
             except QueueFullError as e:
@@ -293,10 +333,19 @@ def _make_handler(server: ServeServer):
                                  + line + b"\r\n")
                 self.wfile.flush()
 
+            chaos = server.engine.chaos
+            # Every token event carries its index in the GENERATED
+            # sequence ("i"): a resumed request starts at its resume
+            # offset, so the router's failover relay can suppress a
+            # duplicate at the kill seam by index instead of guessing.
+            idx = req.resume_offset
             try:
                 for kind, val in req.events(timeout=600.0):
+                    if chaos is not None:
+                        chaos.on_stream_line()   # slow-stream injection
                     if kind == "token":
-                        ev = {"token": val}
+                        ev = {"token": val, "i": idx}
+                        idx += 1
                         text = _token_text([val], server.vocab_size)
                         if text is not None:
                             ev["text"] = text

@@ -57,7 +57,8 @@ class GenerateRequest:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, seed: int = 0,
                  deadline_s: float = 0.0,
-                 stop_token: Optional[int] = None):
+                 stop_token: Optional[int] = None,
+                 resume_tokens=None):
         import numpy as np
         self.id = next(_ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -92,7 +93,22 @@ class GenerateRequest:
                            if deadline_s > 0 else None)
         self.first_token_t: Optional[float] = None
         self.done_t: Optional[float] = None
-        self.tokens: List[int] = []
+        # Cross-replica resume (router mid-stream failover,
+        # docs/serving.md "Mid-stream failover & serve-tier chaos"):
+        # tokens another replica already generated AND streamed to the
+        # client. They seed ``self.tokens`` — the engine re-prefills
+        # prompt+generated and the per-(seed, step) sampling keys
+        # continue the exact stream — but are NEVER re-emitted as
+        # events: the client already has them. ``resume_offset`` is
+        # where this replica's token indices start.
+        self.tokens: List[int] = ([int(t) for t in resume_tokens]
+                                  if resume_tokens is not None else [])
+        self.resume_offset = len(self.tokens)
+        if self.resume_offset and self.max_new_tokens \
+                < self.resume_offset:
+            raise ValueError(
+                f"resume_tokens carries {self.resume_offset} tokens "
+                f"but max_new_tokens is {max_new_tokens}")
         self.finish_reason: Optional[str] = None
         self.error: Optional[str] = None
         self._events: "queue.Queue" = queue.Queue()

@@ -147,7 +147,17 @@ class AotProgramStore:
     def save(self, name: str, shape_tag: str, compiled) -> bool:
         """Serialize one compiled executable; best-effort (False on
         any failure — the persistent compilation cache still covers
-        the next boot)."""
+        the next boot).
+
+        Shared-filesystem safe: a multi-host fleet pointing N
+        replicas at ONE ``--aot-cache`` dir all computes the same
+        entry key, so the commit is deduplicated — the payload is
+        staged under its CONTENT digest (two hosts serializing
+        concurrently never collide on the tmp name) and committed
+        under an ``flock``-guarded check: whichever host wins writes
+        once, every later writer sees the committed entry and returns
+        without touching the file. Still torn-write-safe (tmp +
+        rename) like every other artifact writer in the repo."""
         from jax.experimental import serialize_executable
         try:
             blob, in_tree, out_tree = serialize_executable.serialize(
@@ -158,12 +168,41 @@ class AotProgramStore:
             # trust an entry that was not load-verified at save time.
             serialize_executable.deserialize_and_load(
                 blob, in_tree, out_tree)
+            payload = pickle.dumps((blob, in_tree, out_tree))
             os.makedirs(self.directory, exist_ok=True)
             path = self._path(name, shape_tag)
-            tmp = path + f".tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                pickle.dump((blob, in_tree, out_tree), f)
-            os.replace(tmp, path)
+            with self._commit_lock(path):
+                if os.path.exists(path):
+                    # Another host/process committed this key while we
+                    # were compiling: dedup — never rewrite an entry
+                    # a replica may be deserializing right now.
+                    return True
+                content = hashlib.sha256(payload).hexdigest()[:16]
+                tmp = path + f".{content}.tmp"
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, path)
             return True
         except Exception:  # noqa: BLE001
             return False
+
+    @staticmethod
+    @contextlib.contextmanager
+    def _commit_lock(path: str):
+        """``flock`` on ``<entry>.lock`` around the exists-check +
+        rename (advisory, NFS-visible where flock is supported). On
+        filesystems/platforms without flock the tmp+rename commit
+        alone still guarantees no torn entry — only the dedup check
+        loses its atomicity."""
+        lock_path = path + ".lock"
+        try:
+            import fcntl
+        except ImportError:          # non-POSIX: rename-only safety
+            yield
+            return
+        with open(lock_path, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
