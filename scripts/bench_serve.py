@@ -54,25 +54,45 @@ def ms(xs, q):
     return None if p is None else round(1e3 * p, 2)
 
 
+def _p99_exemplar(samples):
+    """trace_id of the request at the p99 e2e rank — the slow-request
+    lookup key for the joined timeline (scripts/obs_timeline.py)."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))][1]
+
+
 def run_level(engine, concurrency, *, prompt_len, new_tokens,
               requests_per_client, vocab, seed=0):
     """Closed loop: each of ``concurrency`` clients fires
     ``requests_per_client`` requests back-to-back."""
+    from tpunet.obs import tracing
     rng = np.random.default_rng(seed)
     prompts = [rng.integers(0, vocab, size=prompt_len).astype(np.int32)
                for _ in range(concurrency)]
     ttfts, e2es, depths = [], [], []
+    queues, prefills = [], []
+    exemplars = []  # (e2e_s, trace_id) — p99 slow-request lookup key
     errors = []
     done_tokens = [0] * concurrency
 
     def client(i):
         try:
             for _ in range(requests_per_client):
+                tid = tracing.mint_trace_id()
                 req = engine.submit(prompts[i],
-                                    max_new_tokens=new_tokens)
+                                    max_new_tokens=new_tokens,
+                                    trace_id=tid)
                 req.result(timeout=600)
                 ttfts.append(req.ttft_s)
                 e2es.append(req.e2e_s)
+                if req.queue_s is not None:
+                    queues.append(req.queue_s)
+                if req.prefill_s is not None:
+                    prefills.append(req.prefill_s)
+                if req.e2e_s is not None:
+                    exemplars.append((req.e2e_s, tid))
                 done_tokens[i] += len(req.tokens)
                 depths.append(engine.queue.depth())
         except Exception as e:  # noqa: BLE001 — report, don't hang
@@ -97,8 +117,15 @@ def run_level(engine, concurrency, *, prompt_len, new_tokens,
         "ttft_p50_ms": ms(ttfts, 50),
         "ttft_p90_ms": ms(ttfts, 90),
         "ttft_p99_ms": ms(ttfts, 99),
+        # TTFT decomposition from the scheduler's phase stamps:
+        # queue-wait (submit -> prefill launch) vs prefill compute.
+        "ttft_queue_p50_ms": ms(queues, 50),
+        "ttft_queue_p99_ms": ms(queues, 99),
+        "ttft_prefill_p50_ms": ms(prefills, 50),
+        "ttft_prefill_p99_ms": ms(prefills, 99),
         "e2e_p50_ms": ms(e2es, 50),
         "e2e_p99_ms": ms(e2es, 99),
+        "p99_exemplar_trace_id": _p99_exemplar(exemplars),
         "queue_depth_mean": round(float(np.mean(depths)), 2)
         if depths else 0.0,
         "queue_depth_max": int(max(depths)) if depths else 0,

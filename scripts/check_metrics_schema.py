@@ -367,6 +367,36 @@ def collect_router_records() -> list:
     return sink.records
 
 
+def collect_trace_records() -> list:
+    """obs_trace via the factored builder (no router/engine needed —
+    the builder IS the record shape): one router-role span with the
+    failover seam fields and one replica-role span with the full
+    phase decomposition, both fed through ``observe_trace`` so the
+    ``trace_*`` instruments exercise their real names."""
+    from tpunet.obs.registry import MemorySink, Registry
+    from tpunet.obs.tracing import build_trace_record, observe_trace
+
+    reg = Registry()
+    reg.set_identity(run_id="trace-check", process_index=0, host="h")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    router_rec = build_trace_record(
+        trace_id="0123456789abcdef", hop=0, role="router",
+        finish_reason="length", tokens=24, failover_count=1,
+        tokens_relayed=12, e2e_s=0.9, error="")
+    replica_rec = build_trace_record(
+        trace_id="0123456789abcdef", hop=2, role="replica",
+        finish_reason="length", queue_s=0.01, prefill_s=0.04,
+        prefill_bucket=64, first_decode_s=0.002, tokens=12,
+        preemptions=1, preempt_wall_s=0.05, resume_offset=12,
+        ttft_s=0.06, e2e_s=0.5,
+        error="replica failed mid-stream")
+    for rec in (router_rec, replica_rec):
+        observe_trace(reg, rec)
+        reg.emit("obs_trace", rec)
+    return sink.records
+
+
 def collect_agg_records() -> list:
     """obs_fleet + every fleet obs_alert reason via a two-stream
     aggregator (one straggling, one leaking, both serving)."""
@@ -439,6 +469,17 @@ def collect_agg_records() -> list:
                 "process_index": 0, "event": "evict", "replica": "r1",
                 "severity": "warn", "cause": "probe_failures",
                 "time": 1234.6})          # router_last_event
+    agg.ingest({"kind": "obs_trace", "run_id": "router-a",
+                "process_index": 0, "trace_id": "0123456789abcdef",
+                "hop": 0, "role": "router", "finish_reason": "length",
+                "tokens": 24, "failover_count": 1,
+                "tokens_relayed": 12, "e2e_s": 0.9})
+    agg.ingest({"kind": "obs_trace", "run_id": "serve-a",
+                "process_index": 0, "trace_id": "0123456789abcdef",
+                "hop": 1, "role": "replica", "finish_reason": "length",
+                "queue_s": 0.01, "prefill_s": 0.04, "prefill_bucket": 64,
+                "first_decode_s": 0.002, "tokens": 12, "ttft_s": 0.06,
+                "e2e_s": 0.5})            # trace_* rollup fields
     agg.emit_rollup()           # straggler + mem_growth + rules + crash
     clock.t += 100.0
     agg.emit_rollup()           # stream_stale for every stream
@@ -473,6 +514,7 @@ def main() -> int:
         records += collect_crash_records(tmp)
     records += collect_serve_records()
     records += collect_router_records()
+    records += collect_trace_records()
     records += collect_agg_records()
     records += collect_regression_records()
     with tempfile.TemporaryDirectory() as tmp:

@@ -10,8 +10,11 @@ the instrumented loop is measurably slower. Since the flight recorder
 ``default`` (recorder on) vs ``no-flightrec`` (same obs config,
 recorder off) is the recorder's own A/B — its design budget is well
 under the subsystem's 0.5% measured overhead bar (two mmap writes per
-span, no syscalls on the step path). Standalone (not collected by
-pytest) so tier-1 wall time is unaffected:
+span, no syscalls on the step path). A serve-path variant drives one
+compiled engine with request tracing off vs on at the router's
+default head sampling (tpunet/obs/tracing.py) under the same bar.
+Standalone (not collected by pytest) so tier-1 wall time is
+unaffected:
 
     JAX_PLATFORMS=cpu python scripts/check_obs_overhead.py
 """
@@ -69,6 +72,72 @@ def time_epochs(trainer) -> list:
     return times
 
 
+SERVE_ROUNDS = 7
+SERVE_REQS = 32
+
+
+def serve_trace_ratio() -> float:
+    """Serve-path A/B on ONE compiled engine: a burst of requests with
+    tracing fully off vs tracing on at the router's default head
+    sampling (1%, tpunet/obs/tracing.py). At default sampling the
+    per-request cost on the untraced path is an empty-``trace_id``
+    check per phase — it must stay inside the same bar as the training
+    path."""
+    import jax
+    import numpy as np
+
+    from tpunet.config import ModelConfig, ServeConfig
+    from tpunet.models import create_model, init_variables
+    from tpunet.obs import tracing
+    from tpunet.serve import Engine
+
+    model_cfg = ModelConfig(name="lm", vit_hidden=32, vit_depth=2,
+                            vit_heads=2, dropout_rate=0.0,
+                            dtype="float32", vocab_size=31,
+                            max_seq_len=48)
+    model = create_model(model_cfg)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+    eng = Engine(model, variables,
+                 ServeConfig(slots=4, queue_max=2 * SERVE_REQS,
+                             prefill_buckets=(8, 16),
+                             default_max_new_tokens=6,
+                             emit_every_s=0.0)).start()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 31, size=6).astype(np.int32)
+               for _ in range(SERVE_REQS)]
+
+    def burst(traced: bool) -> None:
+        reqs = []
+        for p in prompts:
+            tid = ""
+            if traced:
+                t = tracing.mint_trace_id()
+                if tracing.should_sample(0.01, t):
+                    tid = t
+            reqs.append(eng.submit(p, trace_id=tid))
+        for r in reqs:
+            r.result(timeout=120)
+
+    try:
+        burst(False)          # compile warmup, shared by both arms
+        burst(True)
+        off_t, on_t = [], []
+        for _ in range(SERVE_ROUNDS):   # interleaved: jitter is fair
+            t0 = time.perf_counter()
+            burst(False)
+            off_t.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            burst(True)
+            on_t.append(time.perf_counter() - t0)
+    finally:
+        eng.stop()
+    off = statistics.median(off_t)
+    on = statistics.median(on_t)
+    print(f"serve burst median: trace-off {off * 1e3:.1f}ms, "
+          f"trace-default-sampling {on * 1e3:.1f}ms")
+    return on / off if off > 0 else float("inf")
+
+
 def main() -> int:
     # Fourth variant: the alert webhook configured at a dead endpoint
     # but IDLE (a healthy tiny run fires no alerts) — its default-path
@@ -114,6 +183,13 @@ def main() -> int:
     if hook_ratio > MAX_RATIO:
         print("FAIL: an idle webhook sink exceeds the overhead "
               "budget", file=sys.stderr)
+        fail = True
+    trace_ratio = serve_trace_ratio()
+    print(f"serve-trace-default-vs-off ratio {trace_ratio:.3f} "
+          f"(threshold {MAX_RATIO})")
+    if trace_ratio > MAX_RATIO:
+        print("FAIL: request tracing at default sampling exceeds the "
+              "overhead budget", file=sys.stderr)
         fail = True
     if fail:
         return 1

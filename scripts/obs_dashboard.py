@@ -504,9 +504,45 @@ def render_fleet_terminal(rollup: dict, ages: dict, source: str,
                 f"p99 {_ms(rollup.get('serve_ttft_p99_s'))}ms   "
                 f"e2e p50 {_ms(rollup.get('serve_e2e_p50_s'))}ms "
                 f"p99 {_ms(rollup.get('serve_e2e_p99_s'))}ms")
+    if rollup.get("trace_records_total"):
+        line = (f"trace: {rollup['trace_records_total']} sampled")
+        if rollup.get("trace_queue_p99_s") is not None:
+            line += (
+                f"  p99 split queue {_ms(rollup['trace_queue_p99_s'])}"
+                f" / prefill {_ms(rollup.get('trace_prefill_p99_s'))}"
+                f" / first-decode "
+                f"{_ms(rollup.get('trace_first_decode_p99_s'))} ms")
+        out.append(line)
+        for t in rollup.get("trace_slow", [])[:5]:
+            out.append("  " + _trace_exemplar_row(t))
     if len(out) <= 3:
         out.append("waiting for records...")
     return "\n".join(out)
+
+
+def _trace_exemplar_row(t: dict, bar_width: int = 24) -> str:
+    """One slow-trace exemplar line: trace_id (the obs_timeline
+    lookup key), e2e, and a phase bar splitting it into
+    q(ueue)/p(refill)/d(ecode) shares."""
+    e2e = t.get("e2e_s") or 0.0
+    q = t.get("queue_s") or 0.0
+    p = t.get("prefill_s") or 0.0
+    d = max(0.0, e2e - q - p)
+    bar = ""
+    if e2e > 0:
+        nq = int(round(bar_width * q / e2e))
+        np_ = int(round(bar_width * p / e2e))
+        nd = max(0, bar_width - nq - np_) if d > 0 else 0
+        bar = "[" + "q" * nq + "p" * np_ + "d" * nd + "]"
+    extra = ""
+    if t.get("failover_count"):
+        extra += f"  failovers {t['failover_count']}"
+    if t.get("preemptions"):
+        extra += f"  preempts {t['preemptions']}"
+    return (f"{t.get('trace_id', '?'):<16.16} "
+            f"e2e {_ms(e2e):>7}ms  {bar:<{bar_width + 2}} "
+            f"q {_ms(q)} p {_ms(p)} ms  "
+            f"{t.get('finish_reason', '')}{extra}")
 
 
 def render_fleet_html(rollup: dict, streams, source: str,
@@ -654,6 +690,53 @@ def render_fleet_html(rollup: dict, streams, source: str,
         cards.append('<div class="card"><h2>Serve SLO (fleet)</h2>'
                      f'<div class="tiles">{"".join(sv_tiles)}</div>'
                      + table + "</div>")
+
+    if rollup.get("trace_slow"):
+        tr_tiles = []
+
+        def tr_tile(value, key):
+            tr_tiles.append(
+                f'<div class="tile"><div class="v">{e(str(value))}'
+                f'</div><div class="k">{e(key)}</div></div>')
+
+        tr_tile(rollup.get("trace_records_total", 0), "traces sampled")
+        if rollup.get("trace_queue_p99_s") is not None:
+            tr_tile(f"{_ms(rollup['trace_queue_p99_s'])} ms",
+                    "queue p99")
+            tr_tile(f"{_ms(rollup.get('trace_prefill_p99_s'))} ms",
+                    "prefill p99")
+            tr_tile(f"{_ms(rollup.get('trace_first_decode_p99_s'))} ms",
+                    "first-decode p99")
+        body = []
+        for t in rollup["trace_slow"]:
+            e2e = t.get("e2e_s") or 0.0
+            q = t.get("queue_s") or 0.0
+            p = t.get("prefill_s") or 0.0
+            d = max(0.0, e2e - q - p)
+            bar = ""
+            if e2e > 0:
+                segs = (("#e0a030", q), ("#4090e0", p), ("#40c070", d))
+                bar = "".join(
+                    f'<span style="display:inline-block;height:10px;'
+                    f"background:{c};width:{max(1, round(120 * v / e2e))}px"
+                    '"></span>' for c, v in segs if v > 0)
+            body.append(
+                f"<tr><td><code>{e(str(t.get('trace_id', '?')))}</code></td>"
+                f"<td>{_ms(e2e)}</td>"
+                f'<td style="text-align:left">{bar}</td>'
+                f"<td>{_ms(q)}</td><td>{_ms(p)}</td>"
+                f"<td>{e(str(t.get('finish_reason', '')))}</td>"
+                f"<td>{t.get('failover_count', 0)}</td></tr>")
+        cards.append(
+            '<div class="card"><h2>Slow-request exemplars '
+            "(top traces by e2e — join the full span tree with "
+            "scripts/obs_timeline.py)</h2>"
+            f'<div class="tiles">{"".join(tr_tiles)}</div>'
+            "<table><tr><th>trace_id</th><th>e2e ms</th>"
+            '<th style="text-align:left">queue / prefill / decode</th>'
+            "<th>queue ms</th><th>prefill ms</th><th>finish</th>"
+            "<th>failovers</th></tr>"
+            + "".join(body) + "</table></div>")
 
     if alerts:
         body = "".join(

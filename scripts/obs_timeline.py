@@ -7,6 +7,10 @@
     # trainer + serve replica on one clock
     python scripts/obs_timeline.py train-run/ serve-run/ -o trace.json
 
+    # router + N replicas, traces joined on trace_id into one track
+    python scripts/obs_timeline.py --metrics-dir router-run/ \\
+        --metrics-dir rep-a/ --metrics-dir rep-b/ -o trace.json
+
 Converts the crash-durable flightrec event rings (recorded by default
 in every run: span begin/end pairs, host-thread busy/idle flips,
 serve request lifecycles, alerts, epoch marks) into chrome-trace JSON
@@ -27,14 +31,29 @@ from _gate_cli import split_flags  # noqa: E402
 
 
 def main(argv=None) -> int:
-    parsed = split_flags(sys.argv[1:] if argv is None else argv,
-                         ("-o", "--out"))
+    args = list(sys.argv[1:] if argv is None else argv)
+    # --metrics-dir is repeatable (router dir + N replica dirs in one
+    # invocation -> single merged chrome-trace); split_flags is
+    # last-wins so collect the repeats by hand first.
+    dirs = []
+    i = 0
+    while i < len(args):
+        if args[i] == "--metrics-dir":
+            if i + 1 >= len(args):
+                print("--metrics-dir needs a value", file=sys.stderr)
+                return 2
+            dirs.append(args[i + 1])
+            del args[i:i + 2]
+            continue
+        i += 1
+    parsed = split_flags(args, ("-o", "--out"))
     if isinstance(parsed, int):
         return parsed
     flags, paths = parsed
+    paths = dirs + paths
     if not paths:
-        print("usage: obs_timeline.py RUN_DIR... [-o trace.json]",
-              file=sys.stderr)
+        print("usage: obs_timeline.py [--metrics-dir DIR]... RUN_DIR... "
+              "[-o trace.json]", file=sys.stderr)
         return 2
     out = str(flags.get("o") or flags.get("out") or "trace.json")
 

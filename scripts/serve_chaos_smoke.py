@@ -6,7 +6,7 @@ stdlib stub replicas — no engine, no model, no device — so the gate
 runs in seconds and failures point at router logic, not at jax. The
 stubs speak the real replica stream contract (ndjson token events
 with ``i`` indices, ``resume_tokens`` continuation, the done frame)
-with scripted deaths. Four legs:
+with scripted deaths. Five legs:
 
 1. **kill mid-stream** — the stream's replica dies after first bytes
    reached the client (re-emitting its last token at the seam): the
@@ -20,7 +20,11 @@ with scripted deaths. Four legs:
    notices mid-poll, and the stream resumes on the survivor;
 4. **journal cap exceeded** — a stream past ``--failover-journal-
    tokens`` loses protection: replica death yields the HONEST error
-   frame (the documented degradation), never a silent truncation.
+   frame (the documented degradation), never a silent truncation;
+5. **trace propagation** — a client-supplied ``X-Trace-Id`` is
+   stamped on every replica hop across a mid-stream failover with an
+   incrementing ``X-Trace-Hop`` (docs/metrics_schema.md "Request
+   tracing wire format").
 
 ``--real`` adds the slow leg: a supervised fleet of two real
 ``python -m tpunet.serve`` children with ``--chaos
@@ -316,6 +320,48 @@ def leg_journal_cap():
             s.close()
 
 
+def leg_trace_propagation():
+    """Trace leg: a client-supplied ``X-Trace-Id`` survives a
+    kill@tokens-shaped failover — the SAME id is stamped on the dying
+    hop and on the survivor's resume re-submit, with an incrementing
+    ``X-Trace-Hop``, and the router records the span."""
+    stubs = [StubReplica("t0", {"die_after_tokens": 3}),
+             StubReplica("t1")]
+    router, server = make_router([s.url for s in stubs])
+    try:
+        wait_for(lambda: router.healthy_count() == 2, what="2 healthy")
+        tid = "feedc0dedeadbeef"
+        lines = read_stream(f"http://127.0.0.1:{server.port}",
+                            {"tokens": [50], "max_new_tokens": 8,
+                             "stream": True},
+                            headers=[("X-Trace-Id", tid)])
+        done = lines[-1]
+        assert done.get("done") and done["finish_reason"] == "length", \
+            done
+        assert done.get("failover_count") == 1, done
+        assert [ev["i"] for ev in lines if "token" in ev] \
+            == list(range(8)), "indices not exactly-once"
+        hops = []
+        for stub in stubs:
+            for h in stub.headers_seen:
+                low = {k.lower(): v for k, v in h.items()}
+                assert low.get("x-trace-id") == tid, \
+                    f"trace id lost on hop: {low}"
+                assert low.get("x-trace-sampled") == "1", low
+                hops.append(int(low["x-trace-hop"]))
+        assert sorted(hops) == [1, 2], \
+            f"expected hop 1 (dying) + hop 2 (resume), got {hops}"
+        # The router closes the span AFTER the terminating chunk the
+        # client already saw — poll, don't race the handler thread.
+        wait_for(lambda: router.registry.snapshot()
+                 .get("trace_requests_total", 0) >= 1,
+                 what="obs_trace span recorded")
+    finally:
+        server.drain()
+        for s in stubs:
+            s.close()
+
+
 def leg_real_engine():
     """Slow leg (--real): two real serve children, --chaos
     kill@tokens=N:replica=0 — a real SIGKILL of a real engine
@@ -386,7 +432,9 @@ def main() -> int:
             ("wedge -> stall-evict -> failover",
              leg_wedge_stall_evict),
             ("journal cap exceeded -> honest error frame",
-             leg_journal_cap)]
+             leg_journal_cap),
+            ("trace context propagated across failover",
+             leg_trace_propagation)]
     if real:
         legs.append(("real engine: SIGKILL mid-stream, no error "
                      "frame", leg_real_engine))

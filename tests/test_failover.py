@@ -502,10 +502,10 @@ def _pin_session_to(name: str) -> str:
                 if preferred_replica(fakes, f"s:{s}").name == name)
 
 
-def _stream(base, body, timeout=240):
+def _stream(base, body, timeout=240, headers=()):
     req = urllib.request.Request(
         base + "/v1/generate", json.dumps(body).encode(),
-        {"Content-Type": "application/json"})
+        {"Content-Type": "application/json", **dict(headers)})
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return [json.loads(line) for line in resp]
 
@@ -551,9 +551,11 @@ def test_midstream_sigkill_failover_real_http(tmp_path):
         variables = init_variables(model, jax.random.PRNGKey(0),
                                    seq_len=16)
         prompt = np.asarray([17, 5, 211, 42, 9], np.int32)
+        trace_id = "abad1deafee1900d"   # client-supplied: always sampled
         lines = _stream(base, {"tokens": prompt.tolist(),
                                "max_new_tokens": 24, "stream": True,
-                               "session": session})
+                               "session": session},
+                        headers=[("X-Trace-Id", trace_id)])
         done = lines[-1]
         toks = [ev["token"] for ev in lines if "token" in ev]
         assert done.get("done") and done["finish_reason"] == "length", \
@@ -610,6 +612,60 @@ def test_midstream_sigkill_failover_real_http(tmp_path):
     windows = [r for r in recs if r.get("kind") == "obs_router"
                and not r.get("event")]
     assert windows[-1]["failovers_total"] >= 2
+
+    # -- ONE trace_id spans both replicas, seam recorded ---------------
+    # Router-role span: the client-supplied id, closed with the
+    # failover seam accounting (docs/metrics_schema.md "obs_trace").
+    spans = [r for r in recs if r.get("kind") == "obs_trace"
+             and r.get("trace_id") == trace_id]
+    assert len(spans) == 1 and spans[0]["role"] == "router", spans
+    assert spans[0]["hop"] == 0
+    assert spans[0]["finish_reason"] == "length"
+    assert spans[0]["tokens"] == 24
+    assert spans[0]["failover_count"] >= 1
+    assert spans[0].get("tokens_relayed") is not None
+    # Replica-role span: the SIGKILLed first hop never finishes (its
+    # breadcrumbs survive in the crash-durable ring); the survivor's
+    # resumed hop emits its span with the resume offset.
+    rep_spans = []
+    for rep_dir in sorted(tmp_path.glob("replica-*")):
+        mfile = rep_dir / "metrics.jsonl"
+        if not mfile.exists():
+            continue
+        rep_spans += [json.loads(line) for line
+                      in mfile.read_text().splitlines()
+                      if '"obs_trace"' in line]
+    rep_spans = [r for r in rep_spans if r.get("trace_id") == trace_id]
+    assert rep_spans, "no surviving replica emitted the resumed span"
+    resumed = next(r for r in rep_spans if r.get("resume_offset"))
+    assert resumed["role"] == "replica" and resumed["hop"] >= 2
+    assert resumed["resume_offset"] + resumed["tokens"] == 24
+    assert resumed["finish_reason"] == "length"
+
+    # -- the timeline join renders one causal track --------------------
+    from tpunet.obs.history.timeline import build_timeline
+    trace = build_timeline(
+        [str(tmp_path)] + [str(d) for d
+                           in sorted(tmp_path.glob("replica-*"))])
+    joined = [e for e in trace["traceEvents"]
+              if e.get("args", {}).get("trace_id") == trace_id
+              and e["pid"] == 1]
+    names = {e["name"] for e in joined}
+    assert "relay" in names, "router relay span missing from the join"
+    assert "seam" in names, "failover seam missing from the join"
+    # The dying hop's orphaned lifecycle is force-closed at the seam.
+    assert any(e.get("args", {}).get("force_closed") == "failover_seam"
+               for e in joined), "first hop never force-closed"
+    # The track spans BOTH replicas: the router's open crumbs name a
+    # different serving replica per hop (the victim's own ring was
+    # recycled by its respawn — the router's record is what survives).
+    reps = {e["args"]["replica"] for e in joined
+            if e.get("args", {}).get("replica")}
+    assert len(reps) >= 2, \
+        f"trace does not span both replicas: {reps}"
+    # The survivor's own breadcrumbs joined the track too.
+    assert any(e.get("args", {}).get("process") for e in joined), \
+        "no replica-side crumbs joined the track"
 
 
 def _wait(pred, timeout=20.0, what=""):

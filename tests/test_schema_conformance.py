@@ -98,6 +98,35 @@ def test_router_records_emitted_and_rolled_up():
     assert any(r.get("router_replicas") == 2 for r in rollups)
 
 
+def test_trace_records_emitted_and_rolled_up():
+    """obs_trace flows through the real builder (router + replica
+    roles) with the trace_* instruments observed, and the fleet
+    aggregator decomposes the phases and keeps slow exemplars."""
+    checker = _import_checker()
+    records = checker.collect_trace_records()
+    assert [r["kind"] for r in records] == ["obs_trace"] * 2
+    router_rec, replica_rec = records
+    assert router_rec["role"] == "router" and router_rec["hop"] == 0
+    assert router_rec["failover_count"] == 1
+    assert router_rec["tokens_relayed"] == 12
+    assert replica_rec["role"] == "replica" and replica_rec["hop"] == 2
+    assert replica_rec["prefill_bucket"] == 64
+    assert replica_rec["resume_offset"] == 12
+    # One request, one id, across both roles.
+    assert router_rec["trace_id"] == replica_rec["trace_id"]
+    assert all(r["run_id"] == "trace-check" for r in records)
+    rollups = [r for r in checker.collect_agg_records()
+               if r.get("kind") == "obs_fleet"]
+    assert any(r.get("trace_records_total") for r in rollups)
+    assert any(r.get("trace_queue_p99_s") is not None for r in rollups)
+    slow = next(r["trace_slow"] for r in rollups
+                if r.get("trace_slow"))
+    # Top-of-list exemplar is the slowest span; its trace_id is the
+    # obs_timeline lookup key.
+    assert slow[0]["e2e_s"] >= slow[-1]["e2e_s"]
+    assert slow[0]["trace_id"] == "0123456789abcdef"
+
+
 def test_checker_catches_drift():
     """The check is only worth its CI minutes if it actually fails on
     an undocumented emission."""

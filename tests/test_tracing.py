@@ -1,0 +1,316 @@
+"""Request tracing (tpunet/obs/tracing.py): trace-id validity, the
+deterministic head sampler, breadcrumb wire round-trip through a real
+flight-recorder ring, span-record field conditioning, the cross-ring
+timeline JOIN (router + replicas on trace_id, failover seam
+force-close), the fleet rollup's per-phase SLO decomposition, the
+dashboard exemplar panel, and the multi-dir obs_timeline CLI."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from tpunet.obs.tracing import (build_trace_record, crumb,
+                                mint_trace_id, observe_trace,
+                                parse_crumb, should_sample,
+                                valid_trace_id)
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+# ---------------------------------------------------------------------------
+# ids + sampling
+# ---------------------------------------------------------------------------
+
+def test_trace_id_mint_and_validity():
+    tid = mint_trace_id()
+    assert valid_trace_id(tid) and len(tid) == 16
+    assert valid_trace_id("0123456789abcdef")
+    assert valid_trace_id("a" * 8) and valid_trace_id("a" * 32)
+    for bad in (None, "", "xyz", "ABCDEF01", "a" * 7, "a" * 33,
+                "0123456789abcde!", "deadbeef\n"):
+        assert not valid_trace_id(bad), bad
+
+
+def test_head_sampling_is_deterministic_in_the_id():
+    tid = mint_trace_id()
+    assert should_sample(1.0, tid)
+    assert not should_sample(0.0, tid)
+    # Same id, same verdict — a fleet of routers agrees without
+    # coordination.
+    assert should_sample(0.5, tid) == should_sample(0.5, tid)
+    n = sum(should_sample(0.5, mint_trace_id()) for _ in range(1000))
+    assert 350 < n < 650, f"head sampler badly biased: {n}/1000"
+
+
+# ---------------------------------------------------------------------------
+# breadcrumb wire format
+# ---------------------------------------------------------------------------
+
+def test_parse_crumb_roundtrip():
+    c = parse_crumb("prefill 0123456789abcdef 2 rid=5 b=128")
+    assert c == {"verb": "prefill", "trace_id": "0123456789abcdef",
+                 "hop": 2, "rid": "5", "b": "128"}
+    c = parse_crumb("recv feedc0dedeadbeef 0")
+    assert c["verb"] == "recv" and c["hop"] == 0
+    for bad in ("", "prefill", "prefill tid", "prefill tid x",
+                "prefill tid -1"):
+        assert parse_crumb(bad) is None, bad
+
+
+def test_crumb_survives_the_ring_msg_cap(tmp_path):
+    """A crumb written through a REAL ring comes back parseable —
+    the 80-byte msg cap must never truncate the (verb, id, hop) key."""
+    from tpunet.obs import flightrec
+    from tpunet.obs.flightrec.ring import read_ring_file
+
+    rec = flightrec.install(str(tmp_path), watcher=False,
+                            native=False)
+    try:
+        crumb("seam", "f" * 32, 7, tokens=123456,
+              rep="replica-name-quite-long")
+    finally:
+        rec.close()
+        flightrec._REC = None     # disarm: other tests expect no-op
+    ring = os.path.join(str(tmp_path), "flightrec", "events.ring")
+    slots = [s for s in read_ring_file(ring)
+             if s["kind"] == "trace"]
+    assert slots, "crumb never reached the ring"
+    parsed = parse_crumb(slots[-1]["msg"])
+    assert parsed is not None
+    assert parsed["verb"] == "seam" and parsed["hop"] == 7
+    assert parsed["trace_id"] == "f" * 32
+    assert parsed["tokens"] == "123456"
+
+
+# ---------------------------------------------------------------------------
+# span records + instruments
+# ---------------------------------------------------------------------------
+
+def test_build_trace_record_field_conditioning():
+    rec = build_trace_record(
+        trace_id="0123456789abcdef", hop=0, role="router",
+        finish_reason="length", tokens=24, failover_count=0,
+        e2e_s=0.123456789)
+    # Zero failovers / absent optionals stay OFF the record.
+    assert "failover_count" not in rec
+    assert "queue_s" not in rec and "error" not in rec
+    assert rec["e2e_s"] == 0.123457           # 6dp rounding
+    rec = build_trace_record(
+        trace_id="0123456789abcdef", hop=2, role="replica",
+        finish_reason="error", queue_s=0.01, prefill_s=0.02,
+        prefill_bucket=64, first_decode_s=0.003, tokens=5,
+        preemptions=1, preempt_wall_s=0.5, resume_offset=12,
+        error="x" * 500)
+    assert rec["prefill_bucket"] == 64 and rec["resume_offset"] == 12
+    assert rec["preemptions"] == 1
+    assert len(rec["error"]) == 200           # truncated, never huge
+    with pytest.raises(ValueError):
+        build_trace_record(trace_id="t" * 16, hop=0, role="client",
+                           finish_reason="length")
+
+
+def test_observe_trace_feeds_the_trace_instruments():
+    from tpunet.obs.registry import Registry
+
+    reg = Registry()
+    rec = build_trace_record(
+        trace_id="0123456789abcdef", hop=1, role="replica",
+        finish_reason="length", queue_s=0.01, prefill_s=0.04,
+        first_decode_s=0.002, tokens=8, e2e_s=0.5)
+    observe_trace(reg, rec)
+    snap = reg.snapshot()
+    assert snap["trace_requests_total"] == 1.0
+    for phase in ("queue_s", "prefill_s", "first_decode_s", "e2e_s"):
+        assert snap[f"trace_{phase}_count"] == 1, phase
+
+
+# ---------------------------------------------------------------------------
+# timeline join
+# ---------------------------------------------------------------------------
+
+def _ring_dir(tmp_path, name):
+    from tpunet.obs.flightrec.ring import EventRing
+    d = tmp_path / name / "flightrec"
+    d.mkdir(parents=True)
+    return EventRing(str(d / "events.ring"), 64), tmp_path / name
+
+
+def test_timeline_joins_a_failover_trace_across_rings(tmp_path):
+    """Router ring + two replica rings, one trace_id: the join renders
+    a relay row, hop 1 cut (force-closed) at the failover seam on the
+    SIGKILLed replica, hop 2 resuming on the survivor — one causal
+    track across three processes."""
+    from tpunet.obs.history import build_timeline
+
+    tid = "abad1deafee1900d"
+    router_ring, router_dir = _ring_dir(tmp_path, "router")
+    rep0_ring, rep0_dir = _ring_dir(tmp_path, "rep0")
+    rep1_ring, rep1_dir = _ring_dir(tmp_path, "rep1")
+    router_ring.record("trace", f"recv {tid} 0")
+    router_ring.record("trace", f"open {tid} 1 rep=r0")
+    rep0_ring.record("trace", f"submit {tid} 1 rid=1")
+    rep0_ring.record("trace", f"prefill {tid} 1 rid=1 b=64")
+    rep0_ring.record("trace", f"first_token {tid} 1 rid=1")
+    # r0 is SIGKILLed: no finish crumb ever lands on hop 1.
+    router_ring.record("trace", f"seam {tid} 1 tokens=12 rep=r0")
+    router_ring.record("trace", f"open {tid} 2 rep=r1")
+    rep1_ring.record("trace", f"submit {tid} 2 rid=1")
+    rep1_ring.record("trace", f"resume_prefill {tid} 2 rid=1 b=64")
+    rep1_ring.record("trace", f"first_token {tid} 2 rid=1")
+    rep1_ring.record("trace", f"finish {tid} 2 rid=1 reason=length")
+    router_ring.record("trace", f"finish {tid} 0 reason=length")
+    for ring in (router_ring, rep0_ring, rep1_ring):
+        ring.close()
+
+    trace = build_timeline([str(router_dir), str(rep0_dir),
+                            str(rep1_dir)])
+    joined = [e for e in trace["traceEvents"] if e["pid"] == 1]
+    assert joined, "no cross-process join emitted"
+    rows = {e["args"]["name"] for e in joined
+            if e["name"] == "thread_name"}
+    short = tid[:8]
+    assert {f"trace {short} router", f"trace {short} hop 1",
+            f"trace {short} hop 2"} <= rows
+    data = [e for e in joined
+            if e.get("args", {}).get("trace_id") == tid]
+    relay = next(e for e in data if e["name"] == "relay")
+    assert relay["ph"] == "X" and relay["dur"] > 0
+    assert relay["args"]["finish_reason"] == "length"
+    # Hop 1: the orphaned lifecycle is force-closed AT the seam.
+    hop1 = [e for e in data
+            if e.get("args", {}).get("replica") == "r0"]
+    assert any(e.get("args", {}).get("force_closed")
+               == "failover_seam" for e in hop1)
+    seam = next(e for e in data if e["name"] == "seam")
+    hop1_decode = next(e for e in hop1 if e["name"] == "decode")
+    assert hop1_decode["ts"] + hop1_decode["dur"] \
+        == pytest.approx(seam["ts"], abs=1.0)
+    assert hop1_decode["args"]["tokens_relayed"] == "12"
+    # Hop 2: the resume renders as its own phase on the survivor.
+    hop2 = [e for e in data
+            if e.get("args", {}).get("replica") == "r1"]
+    assert any(e["name"] == "resume_prefill" and e["ph"] == "X"
+               for e in hop2)
+    assert {"r0", "r1"} == {e["args"]["replica"] for e in data
+                            if e.get("args", {}).get("replica")}
+
+
+def test_engine_resume_lifecycle_breadcrumbs(tmp_path):
+    """PR-13 gap closed: a per-process ring whose request RESUMED
+    (resume + resume_prefill verbs, no plain prefill) still renders a
+    full queue/prefill/decode lifecycle instead of an orphan."""
+    from tpunet.obs.flightrec.ring import EventRing
+    from tpunet.obs.history import build_timeline
+
+    d = tmp_path / "run" / "flightrec"
+    d.mkdir(parents=True)
+    ring = EventRing(str(d / "events.ring"), 64)
+    ring.record("req", "submit 3 len=17")
+    ring.record("req", "resume 3 off=12")
+    ring.record("req", "resume_prefill 3")
+    ring.record("req", "first_token 3")
+    ring.record("req", "finish 3 length")
+    ring.close()
+    trace = build_timeline([str(tmp_path / "run")])
+    phases = {e["name"] for e in trace["traceEvents"]
+              if e["ph"] == "X" and e.get("args", {}).get("req") == "3"}
+    assert phases == {"queue", "prefill", "decode"}
+    assert any(e["ph"] == "i" and e["name"] == "resume"
+               for e in trace["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# fleet rollup + dashboard
+# ---------------------------------------------------------------------------
+
+def _trace_stream(run_id, e2es):
+    recs = []
+    for i, e2e in enumerate(e2es):
+        recs.append({"kind": "obs_trace", "run_id": run_id,
+                     "process_index": 0,
+                     "trace_id": f"{i:016x}", "hop": 1,
+                     "role": "replica", "finish_reason": "length",
+                     "queue_s": 0.01 * (i + 1), "prefill_s": 0.02,
+                     "first_decode_s": 0.001, "tokens": 8,
+                     "e2e_s": e2e})
+    return recs
+
+
+def test_rollup_trace_decomposition_and_slow_exemplars():
+    from tpunet.obs.agg import Aggregator
+
+    agg = Aggregator()
+    recs = _trace_stream("a", [0.1, 0.9, 0.5]) \
+        + _trace_stream("b", [0.3, 0.7])
+    for r in recs:
+        agg.ingest(r)
+    rollup = agg.rollup()
+    assert rollup["trace_records_total"] == 5
+    assert rollup["trace_queue_p50_s"] is not None
+    assert rollup["trace_prefill_p99_s"] == pytest.approx(0.02)
+    slow = rollup["trace_slow"]
+    assert [t["e2e_s"] for t in slow] \
+        == sorted((t["e2e_s"] for t in slow), reverse=True)
+    assert slow[0]["e2e_s"] == 0.9
+    # Replay purity: ingest order must not change the rollup.
+    agg2 = Aggregator()
+    for r in reversed(recs):
+        agg2.ingest(r)
+    assert agg2.rollup()["trace_slow"] == slow
+
+
+def test_dashboard_renders_slow_trace_exemplars():
+    from tpunet.obs.agg import Aggregator
+
+    sys.path.insert(0, SCRIPTS)
+    try:
+        dash = __import__("obs_dashboard")
+    finally:
+        sys.path.pop(0)
+    agg = Aggregator()
+    for r in _trace_stream("a", [0.1, 0.9]):
+        agg.ingest(r)
+    rollup = agg.rollup()
+    frame = dash.render_fleet_terminal(rollup, {}, "test")
+    assert "trace:" in frame
+    assert f"{1:016x}" in frame          # the slowest span's id
+    assert "queue" in frame and "prefill" in frame
+    html = dash.render_fleet_html(rollup, [], "test")
+    assert "Slow-request exemplars" in html
+    assert f"{1:016x}" in html
+
+
+# ---------------------------------------------------------------------------
+# obs_timeline CLI: repeatable --metrics-dir
+# ---------------------------------------------------------------------------
+
+def test_obs_timeline_cli_merges_multiple_metrics_dirs(tmp_path,
+                                                       capsys):
+    sys.path.insert(0, SCRIPTS)
+    try:
+        cli = __import__("obs_timeline")
+    finally:
+        sys.path.pop(0)
+    tid = "0123456789abcdef"
+    r1, d1 = _ring_dir(tmp_path, "router")
+    r2, d2 = _ring_dir(tmp_path, "rep0")
+    r1.record("trace", f"recv {tid} 0")
+    r1.record("trace", f"open {tid} 1 rep=r0")
+    r2.record("trace", f"submit {tid} 1 rid=1")
+    r2.record("trace", f"finish {tid} 1 rid=1 reason=length")
+    r1.record("trace", f"finish {tid} 0 reason=length")
+    r1.close()
+    r2.close()
+    out = tmp_path / "trace.json"
+    rc = cli.main(["--metrics-dir", str(d1), "--metrics-dir", str(d2),
+                   "-o", str(out)])
+    assert rc == 0
+    trace = json.loads(out.read_text())
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert 1 in pids and len(pids) >= 3   # join + both real rings
+    assert any(e["name"] == "relay" for e in trace["traceEvents"])
+    # A dangling --metrics-dir is a loud usage error.
+    assert cli.main(["--metrics-dir"]) == 2
+    capsys.readouterr()

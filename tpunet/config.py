@@ -481,6 +481,12 @@ class ServeConfig:
     # docs/serving.md "Mid-stream failover & serve-tier chaos". Empty
     # = no injector installed.
     chaos: str = ""
+    # Standalone-serve request tracing (--trace-sample, docs/serving.md
+    # "Request tracing"): head-sample this fraction of requests that
+    # arrive WITHOUT trace headers, minting a trace_id locally. Under
+    # a router the router decides (its headers win); a client-supplied
+    # ``X-Trace-Id`` is always sampled. 0 = only header-carried traces.
+    trace_sample: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -559,6 +565,21 @@ class RouterConfig:
     # scope key naming the child index; unscoped events reach every
     # child). Empty = no injection.
     chaos: str = ""
+    # End-to-end request tracing (--trace-sample, docs/serving.md
+    # "Request tracing"): the frontend mints a trace_id per request
+    # and head-samples this fraction of them (deterministic on the
+    # id); sampled requests carry ``X-Trace-Id`` to every replica hop
+    # — including failover re-submits — and every layer records trace
+    # breadcrumbs + an ``obs_trace`` record. A client-supplied
+    # ``X-Trace-Id`` is always sampled (explicit opt-in).
+    trace_sample: float = 0.01
+    # Tail capture for the requests sampling missed
+    # (--no-trace-all-on-error disables): an UNsampled request that
+    # hits a mid-stream failover or errors still gets a router-hop
+    # ``obs_trace`` record — replica-side phases are absent (the
+    # replicas never saw trace context), but the seam and outcome are
+    # on the books.
+    trace_all_on_error: bool = True
     # Router identity on obs_router records (empty =
     # "router-<host>-<pid>").
     run_id: str = ""

@@ -23,6 +23,11 @@ from tpunet.obs.agg import merge
 # thousand-stream fleet stays in tens of MB.
 EPOCH_KEEP = 64
 STEP_KEEP = 512
+# Per-stream trace digest bounds (obs_trace, tpunet/obs/tracing.py):
+# enough phase samples for stable p99s at default 1% head sampling,
+# a handful of slow-request exemplars for the dashboard panel.
+TRACE_KEEP = 256
+TRACE_SLOW_KEEP = 8
 
 
 class StreamState:
@@ -60,6 +65,14 @@ class StreamState:
         self.router_records = 0
         self.router_events = 0
         self.last_router_event: Optional[dict] = None
+        # Trace digest (``obs_trace``): replica-hop phase samples for
+        # the fleet TTFT decomposition (queue vs prefill vs
+        # first-decode) and a bounded slowest-trace exemplar pool
+        # (top-K by e2e — the dashboard's slow-request panel and the
+        # obs_timeline lookup key).
+        self.trace_records = 0
+        self.trace_phases: deque = deque(maxlen=TRACE_KEEP)
+        self.trace_slow: List[dict] = []
         # Elasticity digest (tpunet/elastic/): membership changes are
         # part of the stream's judgeable history — a shrink explains a
         # throughput step-change the regression panel would otherwise
@@ -121,6 +134,21 @@ class StreamState:
         elif kind == "obs_elastic":
             self.elastic_events += 1
             self.last_elastic = record
+        elif kind == "obs_trace":
+            self.trace_records += 1
+            if record.get("role") == "replica":
+                self.trace_phases.append(
+                    (record.get("queue_s"), record.get("prefill_s"),
+                     record.get("first_decode_s")))
+            if record.get("e2e_s") is not None:
+                # Order-independent top-K (trace_id tie-break): the
+                # same files replayed in any order keep the identical
+                # exemplar set — the rollup purity property.
+                self.trace_slow.append(record)
+                self.trace_slow.sort(
+                    key=lambda r: (-(r.get("e2e_s") or 0.0),
+                                   str(r.get("trace_id", ""))))
+                del self.trace_slow[TRACE_SLOW_KEEP:]
 
     # -- derived ---------------------------------------------------------
 
@@ -309,6 +337,39 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
                 out[f"serve_{key}_p99_s"] = round(merged[99], 6)
                 out[f"serve_{key}_rank_err"] = round(
                     merge.rank_error_bound(parts), 4)
+
+    # -- trace SLO decomposition -----------------------------------------
+    # Per-phase quantiles over every stream's sampled obs_trace
+    # records: the fleet TTFT p99 split into where the time went
+    # (admission queue vs prefill compute vs first-decode), plus the
+    # fleet-wide slowest-trace exemplars.
+    tracers = [s for s in streams if s.trace_records]
+    if tracers:
+        from tpunet.obs.registry import percentile_of_sorted
+        out["trace_records_total"] = sum(s.trace_records
+                                         for s in tracers)
+        phases = [p for s in tracers for p in list(s.trace_phases)]
+        for i, name in enumerate(("queue", "prefill",
+                                  "first_decode")):
+            vals = sorted(p[i] for p in phases if p[i] is not None)
+            if vals:
+                out[f"trace_{name}_p50_s"] = round(
+                    percentile_of_sorted(vals, 50), 6)
+                out[f"trace_{name}_p99_s"] = round(
+                    percentile_of_sorted(vals, 99), 6)
+        slow = sorted((r for s in tracers for r in s.trace_slow),
+                      key=lambda r: (-(r.get("e2e_s") or 0.0),
+                                     str(r.get("trace_id", ""))))
+        slow = slow[:TRACE_SLOW_KEEP]
+        if slow:
+            out["trace_slow"] = [
+                {k: r[k] for k in
+                 ("trace_id", "role", "hop", "e2e_s", "ttft_s",
+                  "queue_s", "prefill_s", "first_decode_s",
+                  "finish_reason", "failover_count", "preemptions",
+                  "tokens_relayed")
+                 if r.get(k) is not None}
+                for r in slow]
 
     # -- router rollup ---------------------------------------------------
     routers = [s for s in streams if s.last_router is not None

@@ -37,6 +37,7 @@ import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tpunet.obs.flightrec import ring as _ring
+from tpunet.obs.tracing import parse_crumb
 
 #: Instant-event kinds worth a mark on the timeline (everything not
 #: otherwise structured lands here too — unknown kinds degrade to
@@ -105,7 +106,8 @@ class _ProcessTrack:
     REQ_TRACK_BASE = 2000
 
     def __init__(self, pid: int, label: str,
-                 thread_names: Dict[int, str]):
+                 thread_names: Dict[int, str],
+                 trace_join: "Optional[_TraceJoin]" = None):
         self.pid = pid
         self.label = label
         self.events: List[dict] = []
@@ -115,6 +117,7 @@ class _ProcessTrack:
         self._busy: Dict[str, float] = {}      # thread name -> busy ts
         self._beat_tids: Dict[str, int] = {}
         self._reqs: Dict[str, dict] = {}
+        self._trace_join = trace_join
         self._last_ts = 0.0
 
     # -- track bookkeeping ----------------------------------------------
@@ -169,6 +172,21 @@ class _ProcessTrack:
             req.setdefault(verb, ts)
             if verb == "finish" and len(parts) > 2:
                 req["reason"] = parts[2]
+        elif kind == "trace":
+            # Cross-process breadcrumb (tpunet/obs/tracing.py): fed to
+            # the shared join — rings share the wall clock, so one
+            # trace's crumbs from a router ring and N replica rings
+            # line up causally — plus a local instant so the crumb is
+            # visible in this process's own track too.
+            crumb = parse_crumb(msg)
+            if crumb is None:
+                return
+            if self._trace_join is not None:
+                self._trace_join.feed(crumb, ts, self.label)
+            self._emit(name=f"trace {crumb['verb']}", ph="i", ts=ts,
+                       tid=tid, s="t",
+                       args={"trace_id": crumb["trace_id"],
+                             "hop": crumb["hop"]})
         else:
             self._emit(name=f"{kind}: {msg}" if msg else kind,
                        ph="i", ts=ts, tid=tid, s="t")
@@ -197,10 +215,14 @@ class _ProcessTrack:
             self._emit(name="thread_name", ph="M", ts=0.0, tid=tid,
                        args={"name": f"req {rid}"})
             end = req.get("finish", self._last_ts)
+            # A request whose only prefill was a resume-prefill (a
+            # cross-replica failover resume landing on this replica)
+            # still gets a prefill phase — the re-prefill IS the
+            # request's compute cost here.
+            pf = req.get("prefill", req.get("resume_prefill"))
             marks = [("queue", req.get("submit"),
-                      req.get("prefill", end)),
-                     ("prefill", req.get("prefill"),
-                      req.get("first_token", end)),
+                      pf if pf is not None else end),
+                     ("prefill", pf, req.get("first_token", end)),
                      ("decode", req.get("first_token"), end)]
             for name, t0, t1 in marks:
                 if t0 is None:
@@ -212,12 +234,13 @@ class _ProcessTrack:
                            dur=max(0.0, min(t1, end) - t0), tid=tid,
                            args=args)
             # Non-phase lifecycle verbs (client_gone on a mid-stream
-            # disconnect) become instants on the request's own track —
-            # a decode ending "cancelled" with this mark next to it
-            # reads as the client's fault, not the engine's.
+            # disconnect, resume on a failover landing) become
+            # instants on the request's own track — a decode ending
+            # "cancelled" with this mark next to it reads as the
+            # client's fault, not the engine's.
             for verb, t in sorted(req.items()):
-                if verb in ("submit", "prefill", "first_token",
-                            "finish", "reason"):
+                if verb in ("submit", "prefill", "resume_prefill",
+                            "first_token", "finish", "reason"):
                     continue
                 self._emit(name=verb, ph="i", ts=t, tid=tid, s="t",
                            args={"req": rid})
@@ -244,6 +267,113 @@ def _req_sort_key(rid: str):
     return (0, int(rid)) if rid.isdigit() else (1, rid)
 
 
+class _TraceJoin:
+    """Cross-process request join: ``trace``-kind crumbs from EVERY
+    ring (a router dir + N replica dirs), grouped by trace_id, render
+    as one synthetic "trace" process — per trace, a router relay row
+    plus one row per hop, so a failed-over request reads as a single
+    causal track: hop-1 queue/prefill/decode cut at the failover seam,
+    hop-2 resume-prefill/decode continuing it. A first hop whose
+    replica was SIGKILLed never wrote a finish crumb; its decode phase
+    is force-closed at the ROUTER's seam timestamp (the orphaned-
+    lifecycle fix the per-process view can't make — only the router
+    knows when the stream actually died)."""
+
+    PID = 1                 # real rings start at pid 100
+    TRACK_STRIDE = 8        # rows per trace: router + up to 7 hops
+
+    def __init__(self):
+        # trace_id -> [(ts, crumb, source label)]
+        self._traces: Dict[str, List[Tuple[float, dict, str]]] = {}
+
+    def feed(self, crumb: dict, ts: float, source: str) -> None:
+        self._traces.setdefault(crumb["trace_id"], []).append(
+            (ts, crumb, source))
+
+    def _hop_rows(self, trace_id: str, base: int, evs) -> List[dict]:
+        out: List[dict] = []
+        last_ts = max(ts for ts, _, _ in evs)
+        by_hop: Dict[int, List[Tuple[float, dict, str]]] = {}
+        for ts, c, src in evs:
+            by_hop.setdefault(min(c["hop"],
+                                  self.TRACK_STRIDE - 1), []).append(
+                (ts, c, src))
+        short = trace_id[:8]
+        for hop in sorted(by_hop):
+            tid = base + hop
+            row = "router" if hop == 0 else f"hop {hop}"
+            out.append({"name": "thread_name", "ph": "M", "ts": 0.0,
+                        "pid": self.PID, "tid": tid,
+                        "args": {"name": f"trace {short} {row}"}})
+            first: Dict[str, float] = {}
+            meta: Dict[str, str] = {}
+            source = ""
+            for ts, c, src in by_hop[hop]:
+                first.setdefault(c["verb"], ts)
+                if c["verb"] == "finish" and "reason" in c:
+                    meta["finish_reason"] = c["reason"]
+                if c["verb"] == "seam" and "tokens" in c:
+                    meta["tokens_relayed"] = c["tokens"]
+                if c["verb"] == "open" and "rep" in c:
+                    # The ROUTER's record of which replica served this
+                    # hop — survives even when that replica's ring is
+                    # gone (a SIGKILLed victim's respawn truncates it).
+                    meta["replica"] = c["rep"]
+                if c["verb"] not in ("recv", "open", "seam",
+                                     "finish"):
+                    source = src      # the replica that computed
+            args = {"trace_id": trace_id, **meta}
+            if source:
+                args["process"] = source
+            if hop == 0:
+                t0 = first.get("recv", by_hop[hop][0][0])
+                t1 = first.get("finish", last_ts)
+                out.append({"name": "relay", "ph": "X", "ts": t0,
+                            "dur": max(0.0, t1 - t0),
+                            "pid": self.PID, "tid": tid,
+                            "args": args})
+                continue
+            end = first.get("finish")
+            if end is None and "seam" in first:
+                end = first["seam"]
+                args["force_closed"] = "failover_seam"
+            if end is None:
+                end = last_ts
+            pf = first.get("prefill", first.get("resume_prefill"))
+            marks = [("queue", first.get("submit"),
+                      pf if pf is not None else end),
+                     ("resume_prefill" if "resume_prefill" in first
+                      else "prefill", pf,
+                      first.get("first_token", end)),
+                     ("decode", first.get("first_token"), end)]
+            for name, t0, t1 in marks:
+                if t0 is None:
+                    continue
+                out.append({"name": name, "ph": "X", "ts": t0,
+                            "dur": max(0.0, min(t1, end) - t0),
+                            "pid": self.PID, "tid": tid,
+                            "args": args})
+            for verb in ("open", "seam", "preempt"):
+                if verb in first:
+                    out.append({"name": verb, "ph": "i", "s": "t",
+                                "ts": first[verb], "pid": self.PID,
+                                "tid": tid, "args": args})
+        return out
+
+    def finalize(self) -> List[dict]:
+        if not self._traces:
+            return []
+        out = [{"name": "process_name", "ph": "M", "ts": 0.0,
+                "pid": self.PID, "tid": 0,
+                "args": {"name": "trace (cross-process join)"}}]
+        for idx, trace_id in enumerate(sorted(self._traces)):
+            evs = sorted(self._traces[trace_id],
+                         key=lambda e: e[0])
+            out.extend(self._hop_rows(trace_id,
+                                      idx * self.TRACK_STRIDE, evs))
+        return out
+
+
 def build_timeline(run_dirs: Sequence[str]) -> dict:
     """One chrome-trace dict from any number of run dirs. Raises
     FileNotFoundError when none of them contains a flight-recorder
@@ -268,6 +398,7 @@ def build_timeline(run_dirs: Sequence[str]) -> dict:
     t_min = t_min or 0.0
 
     out_events: List[dict] = []
+    join = _TraceJoin()
     for i, (run_dir, pidx, path, events) in enumerate(parsed):
         meta = _read_meta(path, pidx)
         label = os.path.basename(os.path.normpath(run_dir)) or run_dir
@@ -277,10 +408,12 @@ def build_timeline(run_dirs: Sequence[str]) -> dict:
             label = f"{label} p{pidx}"
         track = _ProcessTrack(
             pid=(i + 1) * 100 + pidx, label=label,
-            thread_names=_read_thread_names(path, pidx))
+            thread_names=_read_thread_names(path, pidx),
+            trace_join=join)
         for e in events:
             track.feed(e, round((e["t"] - t_min) * 1e6, 3))
         out_events.extend(track.finalize())
+    out_events.extend(join.finalize())
 
     # Metadata first, then everything else in timestamp order —
     # non-decreasing ts is part of the exported contract.

@@ -101,6 +101,21 @@ def build_argparser():
                         "grammar + ':replica=I' scope; unscoped "
                         "events reach every child) — the failover "
                         "matrix scripts/serve_chaos_smoke.py runs on")
+    p.add_argument("--trace-sample", type=float,
+                   default=d.trace_sample, metavar="RATE",
+                   help="end-to-end request tracing head-sample rate "
+                        "in [0,1] (tpunet/obs/tracing.py): sampled "
+                        "requests carry X-Trace-Id to every replica "
+                        "hop (failover re-submits included) and emit "
+                        "obs_trace span records; a client-supplied "
+                        "X-Trace-Id is always sampled")
+    p.add_argument("--trace-all-on-error",
+                   default=d.trace_all_on_error,
+                   action=argparse.BooleanOptionalAction,
+                   help="tail capture for unsampled requests "
+                        "(default on): one router-hop obs_trace "
+                        "record for any request that fails over or "
+                        "errors, even below the sample rate")
     p.add_argument("--request-timeout-s", type=float,
                    default=d.request_timeout_s)
     p.add_argument("--emit-every-s", type=float, default=d.emit_every_s,
@@ -181,6 +196,8 @@ def build_router_config(args):
         failover_journal_tokens=args.failover_journal_tokens,
         failover_retries=args.failover_retries,
         chaos=args.chaos,
+        trace_sample=args.trace_sample,
+        trace_all_on_error=args.trace_all_on_error,
         run_id=args.run_id)
 
 

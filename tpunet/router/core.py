@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 from tpunet.obs import flightrec
 from tpunet.obs.registry import Registry
+from tpunet.obs.tracing import observe_trace
 from tpunet.router import replica as rstate
 from tpunet.router.balance import affinity_key, pick_replica
 from tpunet.router.journal import RequestJournal
@@ -164,6 +165,14 @@ class Router:
 
     def observe_e2e(self, seconds: float) -> None:
         self.registry.histogram("router_e2e_s").observe(seconds)
+
+    def note_trace(self, record: dict) -> None:
+        """One router-hop ``obs_trace`` span closed (sampled request
+        finished, or an unsampled one earned tail capture via
+        trace-all-on-error): bump the ``trace_*`` instruments and ship
+        the record through the sinks."""
+        observe_trace(self.registry, record)
+        self.registry.emit("obs_trace", record)
 
     def replica_failed(self, rep: ReplicaHandle) -> None:
         """A proxied request hit a transport failure: probe it NOW

@@ -42,12 +42,13 @@ import http.client
 import json
 import threading
 import time
+import types
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from tpunet.obs import flightrec
+from tpunet.obs import flightrec, tracing
 from tpunet.router import replica as rstate
 from tpunet.router.core import Router
 from tpunet.serve import httpjson
@@ -220,17 +221,40 @@ def _make_handler(server: RouterServer):
                     f"X-Deadline-Ms must be positive, got {hdr!r}")
             return time.monotonic() + ms / 1e3
 
+        def _trace_context(self):
+            """(trace_id, sampled) for this request (tpunet/obs/
+            tracing.py): a client-supplied valid ``X-Trace-Id`` is
+            adopted and always sampled (explicit opt-in); otherwise a
+            fresh id is minted and head-sampled at
+            ``cfg.trace_sample``. ("", False) when tracing is fully
+            off — call sites short-circuit on the empty id."""
+            tid = self.headers.get(tracing.TRACE_HEADER)
+            if tracing.valid_trace_id(tid):
+                return tid, True
+            if cfg.trace_sample <= 0 and not cfg.trace_all_on_error:
+                return "", False
+            tid = tracing.mint_trace_id()
+            return tid, tracing.should_sample(cfg.trace_sample, tid)
+
         @staticmethod
-        def _replica_headers(deadline_t: Optional[float]) -> dict:
+        def _replica_headers(deadline_t: Optional[float],
+                             trace=None) -> dict:
             """Headers for one replica-bound request: the remaining
             deadline budget rides along so the engine's scheduler
             enforces the CLIENT's clock, and a failover retry can
-            never exceed the original budget."""
+            never exceed the original budget. A sampled trace context
+            (``trace``: anything with trace_id/trace_sampled/hop —
+            a JournalEntry or the _proxy shim) stamps the trace
+            headers on the hop, failover re-submits included."""
             headers = {"Content-Type": "application/json"}
             if deadline_t is not None:
                 remaining = max(1.0,
                                 1e3 * (deadline_t - time.monotonic()))
                 headers["X-Deadline-Ms"] = f"{remaining:.0f}"
+            if trace is not None and trace.trace_sampled:
+                headers[tracing.TRACE_HEADER] = trace.trace_id
+                headers[tracing.SAMPLED_HEADER] = "1"
+                headers[tracing.HOP_HEADER] = str(trace.hop)
             return headers
 
         # -- GET -------------------------------------------------------
@@ -285,7 +309,7 @@ def _make_handler(server: RouterServer):
 
         def _open_on_fleet(self, body: dict, path: str, tried: set,
                            *, affine: bool,
-                           deadline_t: Optional[float]):
+                           deadline_t: Optional[float], trace=None):
             """Pick a replica and open the request, re-routing around
             dead/draining replicas BEFORE any response byte exists.
             Returns one of::
@@ -295,6 +319,11 @@ def _make_handler(server: RouterServer):
                                            relay verbatim
                 ("reject", code, payload, headers)
                                            exhausted / expired
+
+            Every OPEN attempt is one trace hop: ``trace.hop``
+            increments per attempt (route retries and failover
+            re-submits alike), so the headers a replica sees name the
+            span its breadcrumbs belong to.
             """
             raw = json.dumps(body).encode()
             last_error = None
@@ -307,9 +336,11 @@ def _make_handler(server: RouterServer):
                              else router.pick({}, exclude=tried))
                 if rep is None:
                     break
+                if trace is not None and trace.trace_sampled:
+                    trace.hop += 1
                 req = urllib.request.Request(
                     rep.url + path, raw,
-                    self._replica_headers(deadline_t))
+                    self._replica_headers(deadline_t, trace))
                 try:
                     resp = urllib.request.urlopen(
                         req, timeout=cfg.request_timeout_s)
@@ -349,6 +380,9 @@ def _make_handler(server: RouterServer):
                                         "replica": rep.name})
                     continue
                 router.note_routed(rep)
+                if trace is not None and trace.trace_sampled:
+                    tracing.crumb("open", trace.trace_id, trace.hop,
+                                  rep=rep.name)
                 return ("resp", resp, rep)
             router.note_rejected()
             code, payload = last_error or (
@@ -366,11 +400,19 @@ def _make_handler(server: RouterServer):
             except ValueError as e:
                 self._json(400, {"error": str(e)})
                 return
+            # Non-journal paths still propagate trace context so the
+            # replica's span exists; the router-hop record is a
+            # stream-path concern (the relay owns the e2e story).
+            tid, sampled = self._trace_context()
+            trace = (types.SimpleNamespace(
+                trace_id=tid, trace_sampled=sampled, hop=0)
+                if tid else None)
             tried: set = set()
             while True:
                 opened = self._open_on_fleet(body, path, tried,
                                              affine=affine,
-                                             deadline_t=deadline_t)
+                                             deadline_t=deadline_t,
+                                             trace=trace)
                 if opened[0] == "relay":
                     _, code, payload = opened
                     self._json(code, payload)
@@ -437,6 +479,31 @@ def _make_handler(server: RouterServer):
             except OSError:
                 pass
 
+        def _close_trace(self, entry, reason: str, t0: float,
+                         error: str = "") -> None:
+            """Close the router-hop trace span: a ``finish`` crumb
+            plus one router-role ``obs_trace`` record — for every
+            sampled request, and (trace-all-on-error tail capture) for
+            any UNsampled request that failed over or errored. The
+            empty-trace_id check is the whole cost on the untraced
+            path."""
+            if not entry.trace_id:
+                return
+            interesting = bool(entry.failover_count or error
+                               or reason == "error")
+            if not (entry.trace_sampled
+                    or (cfg.trace_all_on_error and interesting)):
+                return
+            if entry.trace_sampled:
+                tracing.crumb("finish", entry.trace_id, 0,
+                              reason=reason)
+            router.note_trace(tracing.build_trace_record(
+                trace_id=entry.trace_id, hop=0, role="router",
+                finish_reason=reason, tokens=len(entry.tokens),
+                failover_count=entry.failover_count,
+                tokens_relayed=entry.tokens_relayed,
+                e2e_s=time.perf_counter() - t0, error=error))
+
         def _generate_stream(self, body: dict) -> None:
             """Streamed /v1/generate with mid-stream failover: journal
             every relayed token; on replica death after first bytes,
@@ -452,11 +519,17 @@ def _make_handler(server: RouterServer):
                 self._json(400, {"error": str(e)})
                 return
             entry = router.journal.open(body, deadline_t)
+            entry.trace_id, entry.trace_sampled = \
+                self._trace_context()
+            if entry.trace_sampled:
+                tracing.crumb("recv", entry.trace_id, 0)
+            self._finish_reason = ""
             try:
                 tried: set = set()
                 opened = self._open_on_fleet(body, "/v1/generate",
                                              tried, affine=True,
-                                             deadline_t=deadline_t)
+                                             deadline_t=deadline_t,
+                                             trace=entry)
                 if opened[0] == "relay":
                     self._json(opened[1], opened[2])
                     return
@@ -476,13 +549,18 @@ def _make_handler(server: RouterServer):
                     resp.close()
                     if outcome == "done":
                         router.observe_e2e(time.perf_counter() - t0)
+                        self._close_trace(
+                            entry, self._finish_reason or "done", t0)
                         return
                     if outcome == "client_gone":
                         flightrec.record(
                             "router", "client gone mid-stream")
+                        self._close_trace(entry, "cancelled", t0,
+                                          "client gone mid-stream")
                         return
                     if outcome == "deadline":
                         self._finish_frame(entry, "deadline")
+                        self._close_trace(entry, "deadline", t0)
                         return
                     # outcome == "failed": the serving replica died
                     # (or wedged into eviction) mid-stream. This is a
@@ -500,6 +578,8 @@ def _make_handler(server: RouterServer):
                             f"failover journal cap "
                             f"({router.journal.max_tokens} tokens); "
                             "retry the request")
+                        self._close_trace(entry, "error", t0,
+                                          "journal over cap")
                         return
                     if entry.failover_count >= cfg.failover_retries:
                         self._finish_frame(
@@ -507,17 +587,29 @@ def _make_handler(server: RouterServer):
                             "replica failed mid-stream and the "
                             f"failover budget "
                             f"({cfg.failover_retries}) is exhausted")
+                        self._close_trace(entry, "error", t0,
+                                          "failover budget exhausted")
                         return
                     if deadline_t is not None \
                             and time.monotonic() >= deadline_t:
                         self._finish_frame(entry, "deadline")
+                        self._close_trace(entry, "deadline", t0)
                         return
                     router.journal.begin_failover(entry)
                     router.note_failover(rep,
                                          tokens=len(entry.tokens))
+                    if entry.trace_sampled:
+                        # The failover seam, on the ROUTER's clock:
+                        # the timeline join pins the first hop's
+                        # orphaned lifecycle closed here.
+                        tracing.crumb("seam", entry.trace_id,
+                                      entry.hop,
+                                      tokens=len(entry.tokens),
+                                      rep=rep.name)
                     opened = self._open_on_fleet(
                         entry.resume_body(), "/v1/generate", tried,
-                        affine=True, deadline_t=deadline_t)
+                        affine=True, deadline_t=deadline_t,
+                        trace=entry)
                     if opened[0] != "resp":
                         router.journal.end_failover(entry)
                         detail = opened[2]
@@ -529,6 +621,10 @@ def _make_handler(server: RouterServer):
                             None if reason == "deadline" else
                             "replica failed mid-stream and no "
                             f"survivor could resume: {detail}")
+                        self._close_trace(
+                            entry, reason, t0,
+                            "" if reason == "deadline" else
+                            "no survivor could resume")
                         return
                     _, resp, rep = opened
                     # Resumed stream open: the request is in-flight on
@@ -603,6 +699,8 @@ def _make_handler(server: RouterServer):
                         return "client_gone"
                     continue
                 if ev.get("done"):
+                    self._finish_reason = str(
+                        ev.get("finish_reason") or "")
                     if entry.failover_count:
                         ev["failover_count"] = entry.failover_count
                         line = (json.dumps(ev) + "\n").encode()

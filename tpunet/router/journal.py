@@ -36,7 +36,8 @@ class JournalEntry:
     its owning frontend handler thread; read by the drain path."""
 
     __slots__ = ("id", "body", "tokens", "over_cap", "failover_count",
-                 "deadline_t", "failing_over")
+                 "deadline_t", "failing_over", "trace_id",
+                 "trace_sampled", "hop", "tokens_relayed")
 
     def __init__(self, body: dict,
                  deadline_t: Optional[float] = None):
@@ -49,6 +50,18 @@ class JournalEntry:
         self.failover_count = 0
         self.deadline_t = deadline_t
         self.failing_over = False
+        # Trace context (tpunet/obs/tracing.py): the id travels on
+        # every hop's headers — including failover re-submits, which
+        # is why it lives HERE next to the resume state. ``hop``
+        # counts replica opens (0 = router itself; each open / re-open
+        # increments), so (trace_id, hop) names one process span.
+        self.trace_id = ""
+        self.trace_sampled = False
+        self.hop = 0
+        # Journal length at the LAST failover seam — what the
+        # ``obs_trace`` router record reports as ``tokens_relayed``
+        # (None until a failover happens).
+        self.tokens_relayed: Optional[int] = None
 
     def remaining_ms(self,
                      now: Optional[float] = None) -> Optional[float]:
@@ -109,6 +122,7 @@ class RequestJournal:
     def begin_failover(self, entry: JournalEntry) -> None:
         entry.failover_count += 1
         entry.failing_over = True
+        entry.tokens_relayed = len(entry.tokens)
 
     def end_failover(self, entry: JournalEntry) -> None:
         entry.failing_over = False

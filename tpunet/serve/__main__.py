@@ -147,6 +147,13 @@ def build_argparser():
                         "stall@tokens=N:ms=M, drop-probe@prob=P:"
                         "seed=X, slow-stream@ms=M — deterministic, "
                         "';'-separated; docs/serving.md grammar")
+    p.add_argument("--trace-sample", type=float,
+                   default=d.trace_sample, metavar="RATE",
+                   help="standalone request-tracing head-sample rate "
+                        "in [0,1] (tpunet/obs/tracing.py): applies to "
+                        "requests WITHOUT router trace headers; a "
+                        "client-supplied X-Trace-Id is always sampled"
+                        " (default 0 = header-carried traces only)")
     p.add_argument("--aot-cache", default=d.aot_cache, metavar="DIR",
                    help="AOT warm-start: serialize the compiled decode"
                         " + prefill executables under DIR on first "
@@ -229,7 +236,7 @@ def build_server(args):
         emit_every_s=args.emit_every_s,
         drain_timeout_s=args.drain_timeout_s,
         run_id=args.run_id, aot_cache=args.aot_cache,
-        chaos=args.chaos)
+        chaos=args.chaos, trace_sample=args.trace_sample)
     model_cfg = ModelConfig(
         name=args.model, vit_hidden=args.vit_hidden,
         vit_depth=args.vit_depth, vit_heads=args.vit_heads,

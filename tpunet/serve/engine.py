@@ -48,6 +48,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from tpunet.obs import tracing
 from tpunet.serve.scheduler import (FINISH_CANCELLED, FINISH_DEADLINE,
                                     FINISH_DRAIN, FINISH_ERROR,
                                     FINISH_LENGTH, FINISH_STOP,
@@ -561,9 +562,13 @@ class Engine:
         self._release_pages(slot_i, slot)
         req = slot.req
         req.preemptions += 1
+        req._preempt_t = time.perf_counter()
         self.registry.counter("serve_kv_preemptions_total").inc()
         from tpunet.obs import flightrec
         flightrec.record("req", f"preempt {req.id}")
+        if req.trace_id:
+            tracing.crumb("preempt", req.trace_id, req.trace_hop,
+                          rid=req.id)
         self.queue.requeue_front([req])
         self.registry.gauge("serve_active_slots").set(
             self.active_slots())
@@ -691,6 +696,16 @@ class Engine:
         # without an armed recorder.
         from tpunet.obs import flightrec
         flightrec.record("req", f"submit {req.id} len={req.prompt.size}")
+        if req.resume_offset:
+            # Cross-replica resume (router failover): without this
+            # mark the request's second half starts with a bare
+            # prefill and the timeline can't tell a resumed stream
+            # from a fresh one.
+            flightrec.record(
+                "req", f"resume {req.id} off={req.resume_offset}")
+        if req.trace_id:
+            tracing.crumb("submit", req.trace_id, req.trace_hop,
+                          rid=req.id)
         self.registry.counter("serve_requests_total").inc()
         self.registry.gauge("serve_queue_depth").set(self.queue.depth())
         self._wake.set()
@@ -851,6 +866,27 @@ class Engine:
             reg.counter("serve_requests_completed").inc()
         if req.e2e_s is not None:
             reg.histogram("serve_e2e_s").observe(req.e2e_s)
+        if req.trace_id:
+            # Close this hop's replica span: crumb for the timeline
+            # join, one obs_trace record with the phase decomposition
+            # for the fleet rollup. The empty-trace_id check above is
+            # the whole cost on the unsampled path.
+            tracing.crumb("finish", req.trace_id, req.trace_hop,
+                          rid=req.id, reason=reason)
+            record = tracing.build_trace_record(
+                trace_id=req.trace_id, hop=req.trace_hop,
+                role="replica", finish_reason=reason,
+                queue_s=req.queue_s, prefill_s=req.prefill_s,
+                prefill_bucket=req.prefill_bucket,
+                first_decode_s=req.first_decode_s,
+                tokens=len(req.tokens) - req.resume_offset,
+                preemptions=req.preemptions,
+                preempt_wall_s=req.preempt_wall_s or None,
+                resume_offset=req.resume_offset,
+                ttft_s=req.ttft_s, e2e_s=req.e2e_s,
+                error=req.error or "")
+            tracing.observe_trace(reg, record)
+            reg.emit("obs_trace", record)
 
     def _finish_slot(self, i: int, reason: str) -> None:
         slot = self._active[i]
@@ -963,8 +999,24 @@ class Engine:
             self._active[slot_i] = slot
         positions = np.zeros((self.slots,), np.int32)
         from tpunet.obs import flightrec
-        for _, req, _, _ in group:
-            flightrec.record("req", f"prefill {req.id}")
+        for _, req, resume, _ in group:
+            # A resume-prefill (preempt-resume or cross-replica
+            # failover resume) re-embeds prompt+generated; the
+            # distinct verb keeps the timeline honest about which
+            # prefills are re-work.
+            if int(resume.size) > int(req.prompt.size):
+                flightrec.record("req", f"resume_prefill {req.id}")
+            else:
+                flightrec.record("req", f"prefill {req.id}")
+            if req.prefill_start_t is None:
+                req.prefill_start_t = t0
+                req.prefill_bucket = bucket
+            if req._preempt_t is not None:
+                req.preempt_wall_s += t0 - req._preempt_t
+                req._preempt_t = None
+            if req.trace_id:
+                tracing.crumb("prefill", req.trace_id, req.trace_hop,
+                              rid=req.id, b=bucket)
         if self.chaos is not None:
             self.chaos.on_prefill()     # kill@prefill injection point
         with _ring_span("tpunet/serve_prefill"):
@@ -979,8 +1031,11 @@ class Engine:
                                                           active)
                 logits = np.asarray(logits)
         reg = self.registry
+        prefill_done = time.perf_counter()
         for slot_i, req, resume, _ in group:
             n = int(resume.size)
+            if req.prefill_done_t is None:
+                req.prefill_done_t = prefill_done
             if self.device_sampling:
                 first = int(sampled[slot_i])
             else:
@@ -990,6 +1045,9 @@ class Engine:
             req.push_token(first)
             if fresh:
                 flightrec.record("req", f"first_token {req.id}")
+                if req.trace_id:
+                    tracing.crumb("first_token", req.trace_id,
+                                  req.trace_hop, rid=req.id)
                 reg.histogram("serve_ttft_s").observe(req.ttft_s)
             reg.counter("serve_tokens_total").inc()
             if self.chaos is not None:

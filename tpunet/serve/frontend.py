@@ -35,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from tpunet.obs import tracing
 from tpunet.serve import httpjson
 from tpunet.serve.engine import Engine, PromptTooLongError
 from tpunet.serve.scheduler import DrainingError, QueueFullError
@@ -250,6 +251,28 @@ def _make_handler(server: ServeServer):
                     f"X-Deadline-Ms must be positive, got {hdr!r}")
             return min(body_s, hdr_s) if body_s > 0 else hdr_s
 
+        def _trace_context(self):
+            """(trace_id, hop) for this request (tpunet/obs/
+            tracing.py). A router upstream decides: its trace headers
+            are adopted verbatim (``X-Trace-Sampled: 0`` would mean
+            unsampled, but the router only stamps sampled hops).
+            Standalone — no trace headers — a client-supplied
+            ``X-Trace-Id`` is always sampled, and ``--trace-sample``
+            head-samples the rest locally. ("", 0) = unsampled."""
+            tid = self.headers.get(tracing.TRACE_HEADER)
+            if tracing.valid_trace_id(tid):
+                sampled = self.headers.get(tracing.SAMPLED_HEADER)
+                if sampled is not None and sampled != "1":
+                    return "", 0
+                hop = self.headers.get(tracing.HOP_HEADER, "1")
+                return tid, (int(hop) if hop.isdigit() else 1)
+            rate = server.engine.cfg.trace_sample
+            if rate > 0:
+                tid = tracing.mint_trace_id()
+                if tracing.should_sample(rate, tid):
+                    return tid, 1
+            return "", 0
+
         def _generate(self, body: dict) -> None:
             try:
                 toks = self._parse_prompt(body)
@@ -262,6 +285,8 @@ def _make_handler(server: ServeServer):
                 resume = self._parse_resume(body)
                 if resume is not None:
                     kw["resume_tokens"] = resume
+                kw["trace_id"], kw["trace_hop"] = \
+                    self._trace_context()
                 req = server.engine.submit(
                     toks, **kw,
                     temperature=float(body.get("temperature", 0.0)),

@@ -58,9 +58,17 @@ class GenerateRequest:
                  top_p: float = 0.0, seed: int = 0,
                  deadline_s: float = 0.0,
                  stop_token: Optional[int] = None,
-                 resume_tokens=None):
+                 resume_tokens=None, trace_id: str = "",
+                 trace_hop: int = 0):
         import numpy as np
         self.id = next(_ids)
+        # Trace context (tpunet/obs/tracing.py): ``self.id`` is
+        # per-PROCESS; the (trace_id, trace_hop) pair the router
+        # stamped on the hop's headers is what names this span across
+        # the fleet. Empty trace_id = unsampled; every trace call
+        # site short-circuits on it.
+        self.trace_id = str(trace_id)
+        self.trace_hop = int(trace_hop)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("prompt must contain at least one token")
@@ -93,6 +101,17 @@ class GenerateRequest:
                            if deadline_s > 0 else None)
         self.first_token_t: Optional[float] = None
         self.done_t: Optional[float] = None
+        # Phase stamps for the TTFT decomposition (queue vs prefill
+        # vs first-decode) the ``obs_trace`` record and bench_serve
+        # report. Set by the engine at admission / prefill; cheap
+        # enough to stamp unconditionally (sampled or not).
+        self.prefill_start_t: Optional[float] = None
+        self.prefill_done_t: Optional[float] = None
+        self.prefill_bucket: Optional[int] = None
+        # Wall-clock spent preempted out of a slot (paged-KV pool
+        # pressure): accumulated preempt -> resume-prefill.
+        self.preempt_wall_s = 0.0
+        self._preempt_t: Optional[float] = None
         # Cross-replica resume (router mid-stream failover,
         # docs/serving.md "Mid-stream failover & serve-tier chaos"):
         # tokens another replica already generated AND streamed to the
@@ -194,6 +213,29 @@ class GenerateRequest:
         if self.done_t is None:
             return None
         return self.done_t - self.submitted_t
+
+    # TTFT decomposition: queue_s + prefill_s + first_decode_s ~=
+    # ttft_s (the residual is host scheduling slack). Each is None
+    # until its closing stamp exists.
+
+    @property
+    def queue_s(self) -> Optional[float]:
+        if self.prefill_start_t is None:
+            return None
+        return self.prefill_start_t - self.submitted_t
+
+    @property
+    def prefill_s(self) -> Optional[float]:
+        if self.prefill_start_t is None \
+                or self.prefill_done_t is None:
+            return None
+        return self.prefill_done_t - self.prefill_start_t
+
+    @property
+    def first_decode_s(self) -> Optional[float]:
+        if self.prefill_done_t is None or self.first_token_t is None:
+            return None
+        return self.first_token_t - self.prefill_done_t
 
 
 class RequestQueue:
