@@ -397,6 +397,39 @@ def collect_trace_records() -> list:
     return sink.records
 
 
+def collect_slo_records() -> list:
+    """obs_slo + the slo_fast_burn / slo_slow_burn obs_alert flavors
+    via the real engine (tpunet/obs/slo.py): the default policy is
+    loaded, the availability stream is burned hard enough to fire the
+    fast-burn page, a probe mismatch carries a trace id into the
+    correctness page, and ``evaluate()`` records are emitted exactly
+    the way the router control loop emits them."""
+    from tpunet.obs.registry import MemorySink, Registry
+    from tpunet.obs.slo import SloEngine, load_policy
+
+    clock = _FakeClock()
+    reg = Registry()
+    reg.set_identity(run_id="slo-check", process_index=0, host="h")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    engine = SloEngine(load_policy(), registry=reg, clock=clock)
+    for i in range(40):                     # healthy baseline
+        engine.note_request(True)
+        engine.note_latency("ttft", 0.01)
+        engine.note_latency("e2e", 0.1)
+        clock.t += 1.0
+    for _ in range(40):                     # sustained burn -> page
+        engine.note_request(False)
+        clock.t += 1.0
+        engine.evaluate()
+    engine.note_probe(ok=True, mismatch=True, ttft_s=0.02, e2e_s=0.2,
+                      trace_id="0123456789abcdef")   # correctness page
+    engine.evaluate()
+    for rec in engine.evaluate():           # the control-loop emission
+        reg.emit("obs_slo", rec)
+    return sink.records
+
+
 def collect_agg_records() -> list:
     """obs_fleet + every fleet obs_alert reason via a two-stream
     aggregator (one straggling, one leaking, both serving)."""
@@ -480,6 +513,23 @@ def collect_agg_records() -> list:
                 "queue_s": 0.01, "prefill_s": 0.04, "prefill_bucket": 64,
                 "first_decode_s": 0.002, "tokens": 12, "ttft_s": 0.06,
                 "e2e_s": 0.5})            # trace_* rollup fields
+    agg.ingest({"kind": "obs_slo", "run_id": "router-a",
+                "process_index": 0, "name": "availability",
+                "sli": "availability", "objective": 0.999,
+                "compliance_window_s": 3600.0, "events": 120,
+                "bad": 3, "error_rate": 0.025,
+                "budget_remaining": 0.4, "page_burn_long": 25.0,
+                "page_burn_short": 30.0, "page_burn_threshold": 14.4,
+                "page_window_long_s": 300.0,
+                "page_window_short_s": 36.0, "page_firing": 1,
+                "ticket_burn_long": 25.0, "ticket_burn_short": 25.0,
+                "ticket_burn_threshold": 3.0,
+                "ticket_window_long_s": 3600.0,
+                "ticket_window_short_s": 300.0, "pages_total": 1,
+                "tickets_total": 1, "probe_requests": 40,
+                "probe_failures": 3, "probe_mismatches": 1,
+                "last_failed_trace": "0123456789abcdef"
+                })                        # fleet_slo_* rollup fields
     agg.emit_rollup()           # straggler + mem_growth + rules + crash
     clock.t += 100.0
     agg.emit_rollup()           # stream_stale for every stream
@@ -515,6 +565,7 @@ def main() -> int:
     records += collect_serve_records()
     records += collect_router_records()
     records += collect_trace_records()
+    records += collect_slo_records()
     records += collect_agg_records()
     records += collect_regression_records()
     with tempfile.TemporaryDirectory() as tmp:

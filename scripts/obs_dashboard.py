@@ -504,6 +504,41 @@ def render_fleet_terminal(rollup: dict, ages: dict, source: str,
                 f"p99 {_ms(rollup.get('serve_ttft_p99_s'))}ms   "
                 f"e2e p50 {_ms(rollup.get('serve_e2e_p50_s'))}ms "
                 f"p99 {_ms(rollup.get('serve_e2e_p99_s'))}ms")
+    if rollup.get("slo_table"):
+        worst = rollup.get("fleet_slo_worst_budget_remaining")
+        line = (f"SLO: {rollup.get('fleet_slo_firing', 0)} firing  "
+                f"pages {rollup.get('fleet_slo_pages_total', 0)}  "
+                f"tickets {rollup.get('fleet_slo_tickets_total', 0)}")
+        if worst is not None:
+            line += (f"  worst budget {100 * worst:.1f}% "
+                     f"({rollup.get('fleet_slo_worst_slo', '?')})")
+        out.append(line)
+        spark = sparkline(rollup.get("slo_burn_spark", []))
+        if spark:
+            out.append(f"  page-burn trend  {spark}")
+        for r in rollup["slo_table"][:8]:
+            b = r.get("budget_remaining")
+            burn = r.get("page_burn_long")
+            flag = ("FIRING" if r.get("page_firing")
+                    else "ticket" if r.get("ticket_firing") else "")
+            out.append(
+                f"  {r.get('name', '?'):<14.14} "
+                f"obj {r.get('objective', 0):<7} "
+                f"budget {'-' if b is None else f'{100 * b:6.1f}%'}  "
+                f"burn {'-' if burn is None else f'{burn:7.2f}x'}  "
+                f"{flag}")
+        if rollup.get("fleet_slo_probe_requests_total"):
+            out.append(
+                f"  probes {rollup['fleet_slo_probe_requests_total']}"
+                f" ({rollup.get('fleet_slo_probe_failures_total', 0)}"
+                f" failed, "
+                f"{rollup.get('fleet_slo_probe_mismatches_total', 0)}"
+                f" golden mismatches)"
+                + (f"  last failed trace "
+                   f"{rollup['fleet_slo_last_failed_trace']}"
+                   if rollup.get("fleet_slo_last_failed_trace")
+                   else ""))
+        out.append("")
     if rollup.get("trace_records_total"):
         line = (f"trace: {rollup['trace_records_total']} sampled")
         if rollup.get("trace_queue_p99_s") is not None:
@@ -690,6 +725,69 @@ def render_fleet_html(rollup: dict, streams, source: str,
         cards.append('<div class="card"><h2>Serve SLO (fleet)</h2>'
                      f'<div class="tiles">{"".join(sv_tiles)}</div>'
                      + table + "</div>")
+
+    if rollup.get("slo_table"):
+        slo_tiles = []
+
+        def slo_tile(value, key):
+            slo_tiles.append(
+                f'<div class="tile"><div class="v">{e(str(value))}'
+                f'</div><div class="k">{e(key)}</div></div>')
+
+        worst = rollup.get("fleet_slo_worst_budget_remaining")
+        if worst is not None:
+            slo_tile(f"{100 * worst:.1f}%",
+                     f"worst budget "
+                     f"({rollup.get('fleet_slo_worst_slo', '?')})")
+        if rollup.get("fleet_slo_max_page_burn") is not None:
+            slo_tile(f"x{rollup['fleet_slo_max_page_burn']:.2f}",
+                     "max page burn")
+        slo_tile(rollup.get("fleet_slo_firing", 0), "SLOs firing")
+        slo_tile(f"{rollup.get('fleet_slo_pages_total', 0)}"
+                 f"/{rollup.get('fleet_slo_tickets_total', 0)}",
+                 "pages/tickets")
+        if rollup.get("fleet_slo_probe_requests_total"):
+            slo_tile(f"{rollup.get('fleet_slo_probe_failures_total', 0)}"
+                     f"+{rollup.get('fleet_slo_probe_mismatches_total', 0)}"
+                     f"/{rollup['fleet_slo_probe_requests_total']}",
+                     "probe fails+mismatches/total")
+        body = []
+        for r in rollup["slo_table"]:
+            b = r.get("budget_remaining")
+            burn = r.get("page_burn_long")
+            firing = ("page" if r.get("page_firing")
+                      else "ticket" if r.get("ticket_firing") else "")
+            cls = ' class="alert"' if firing else ""
+            body.append(
+                f"<tr{cls}><td>{e(str(r.get('stream', '')))}</td>"
+                f"<td>{e(str(r.get('name', '?')))}</td>"
+                f"<td>{e(str(r.get('sli', '')))}</td>"
+                f"<td>{r.get('objective', '-')}</td>"
+                f"<td>{'-' if b is None else f'{100 * b:.1f}%'}</td>"
+                f"<td>{'-' if burn is None else f'x{burn:.2f}'}</td>"
+                f"<td>{r.get('pages_total', 0)}"
+                f"/{r.get('tickets_total', 0)}</td>"
+                f"<td>{e(firing)}</td></tr>")
+        extras = ""
+        spark = sparkline(rollup.get("slo_burn_spark", []), width=48)
+        if spark:
+            extras += (f'<p class="legend">page-burn trend '
+                       f"(worst stream): <code>{e(spark)}</code></p>")
+        if rollup.get("fleet_slo_last_failed_trace"):
+            tid = rollup["fleet_slo_last_failed_trace"]
+            extras += (f'<p class="legend">last failed probe trace: '
+                       f"<code>{e(str(tid))}</code> (join with "
+                       "scripts/obs_timeline.py)</p>")
+        cards.append(
+            '<div class="card"><h2>Error budget (SLOs, '
+            "tpunet/obs/slo.py)</h2>"
+            f'<div class="tiles">{"".join(slo_tiles)}</div>'
+            + extras
+            + "<table><tr><th>stream</th><th>slo</th><th>sli</th>"
+              "<th>objective</th><th>budget left</th>"
+              "<th>page burn</th><th>pages/tickets</th>"
+              "<th>firing</th></tr>"
+            + "".join(body) + "</table></div>")
 
     if rollup.get("trace_slow"):
         tr_tiles = []

@@ -86,7 +86,9 @@ fi
 run_gate "router-smoke" python scripts/router_smoke.py
 
 # Serve-tier chaos matrix against stdlib stub replicas: mid-stream
-# failover (kill/wedge/prefill-death) + the journal-cap degradation.
+# failover (kill/wedge/prefill-death), the journal-cap degradation,
+# and the SLO closed loop (prober-detected stall -> exactly one
+# fast-burn webhook page -> recovery re-arms the latch).
 # --slow adds the real-engine leg (SIGKILL of a real serve child).
 run_gate "serve-chaos-smoke" python scripts/serve_chaos_smoke.py
 

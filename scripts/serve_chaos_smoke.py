@@ -6,7 +6,7 @@ stdlib stub replicas — no engine, no model, no device — so the gate
 runs in seconds and failures point at router logic, not at jax. The
 stubs speak the real replica stream contract (ndjson token events
 with ``i`` indices, ``resume_tokens`` continuation, the done frame)
-with scripted deaths. Five legs:
+with scripted deaths. Six legs:
 
 1. **kill mid-stream** — the stream's replica dies after first bytes
    reached the client (re-emitting its last token at the seam): the
@@ -24,7 +24,14 @@ with scripted deaths. Five legs:
 5. **trace propagation** — a client-supplied ``X-Trace-Id`` is
    stamped on every replica hop across a mid-stream failover with an
    incrementing ``X-Trace-Hop`` (docs/metrics_schema.md "Request
-   tracing wire format").
+   tracing wire format");
+6. **SLO closed loop** — the synthetic prober + burn-rate engine
+   (tpunet/obs/slo.py): a fleet-wide stall that healthz cannot see
+   burns the fast window and lands EXACTLY ONE page (carrying the
+   failing probe's trace id) on a stdlib webhook receiver; recovery
+   clears the latch with no second page and the budget stops
+   draining. Golden outputs stay bitwise-identical across replicas
+   and across a mid-probe failover.
 
 ``--real`` adds the slow leg: a supervised fleet of two real
 ``python -m tpunet.serve`` children with ``--chaos
@@ -362,6 +369,161 @@ def leg_trace_propagation():
             s.close()
 
 
+def leg_slo_closed_loop():
+    """SLO leg: the full error-budget paging loop, end to end. The
+    router runs its synthetic prober (``--probe-every-s``) against a
+    short-window availability SLO (``--slo-policy``):
+
+    - golden phase: probes spread over BOTH replicas and the golden
+      matches the stubs' pure token function (bitwise-stable across
+      replicas);
+    - failover phase: a replica dies mid-PROBE — the resume continues
+      the stream on the survivor and the tokens still match the
+      golden (zero mismatches), with no page;
+    - stall phase: both replicas go slow (healthz stays green — the
+      failure only the prober can see): probes time out, the fast
+      window burns, and EXACTLY ONE page — carrying the failing
+      probe's trace id — reaches a stdlib webhook receiver;
+    - recovery: probes pass again, the latch clears with no second
+      page, and the error budget stops draining.
+    """
+    import tempfile
+
+    from tpunet.obs import tracing
+    from tpunet.obs.export.webhook import AlertWebhook
+    from tpunet.router.prober import PROBE_NEW_TOKENS, PROBE_PROMPT
+
+    pages = []
+
+    class Hook(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # noqa: D102
+            pass
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length") or 0)
+            pages.append(json.loads(self.rfile.read(n)))
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    receiver = ThreadingHTTPServer(("127.0.0.1", 0), Hook)
+    receiver.daemon_threads = True
+    threading.Thread(target=receiver.serve_forever,
+                     daemon=True).start()
+    hook_url = f"http://127.0.0.1:{receiver.server_address[1]}"
+
+    # Page-only, availability-only policy with seconds-scale windows
+    # (production uses hours — docs/slos.json): "exactly one webhook
+    # POST" is then the whole green condition. short_s stays above
+    # the worst-case failed-probe interval (timeout 0.5s + cadence)
+    # so the short window is never empty mid-burn.
+    policy = {"slos": [{"name": "availability",
+                        "sli": "availability", "objective": 0.9,
+                        "compliance_window_s": 60,
+                        "page": {"long_s": 4.0, "short_s": 1.5,
+                                 "burn": 2.0}}]}
+    fd, policy_path = tempfile.mkstemp(suffix=".json",
+                                       prefix="slo-smoke-")
+    with os.fdopen(fd, "w") as f:
+        f.write("// chaos-smoke SLO policy (short windows)\n"
+                + json.dumps(policy))
+
+    stubs = [StubReplica("s0"), StubReplica("s1")]
+    router, server = make_router([s.url for s in stubs],
+                                 probe_every_s=0.05,
+                                 slo_policy=policy_path,
+                                 emit_every_s=0.2)
+    hook = AlertWebhook(hook_url, kinds=("obs_alert",),
+                        registry=router.registry, name="slo-smoke")
+    router.registry.add_sink(hook)
+    slo_records = []
+
+    class SloTap:
+        def write(self, record):
+            if record.get("kind") == "obs_slo":
+                slo_records.append(record)
+
+    router.registry.add_sink(SloTap())
+    try:
+        engine, prober = router.slo, server.prober
+        assert engine is not None and prober is not None, \
+            "probe_every_s + slo_policy must arm engine and prober"
+        wait_for(lambda: router.healthy_count() == 2, what="2 healthy")
+
+        # -- golden phase: bitwise-stable across replicas ----------
+        wait_for(lambda: prober.golden is not None
+                 and engine.probe_requests >= 10
+                 and stubs[0].requests > 0 and stubs[1].requests > 0,
+                 what="golden established across both replicas")
+        assert prober.golden \
+            == expected_tokens(PROBE_PROMPT[0], PROBE_NEW_TOKENS), \
+            f"golden diverged from the pure stream: {prober.golden}"
+        assert engine.probe_mismatches == 0, "golden unstable"
+
+        # -- mid-probe failover: golden survives the seam ----------
+        stubs[0].behavior["die_after_tokens"] = 3
+        wait_for(lambda: "die_after_tokens" not in stubs[0].behavior,
+                 what="a probe to hit the armed replica")
+        n0 = engine.probe_requests
+        wait_for(lambda: engine.probe_requests >= n0 + 3,
+                 what="post-failover probes")
+        assert engine.probe_mismatches == 0, \
+            "failover resume diverged from the golden"
+        assert router.registry.snapshot() \
+            .get("router_failovers_total", 0) >= 1
+        assert pages == [], f"paged during clean failover: {pages}"
+
+        # -- stall phase: burn the fast window -> exactly one page -
+        for s in stubs:
+            s.behavior["line_delay_s"] = 2.0
+        wait_for(lambda: len(pages) >= 1, timeout=30,
+                 what="fast-burn page at the webhook")
+        assert router.healthy_count() == 2, \
+            "stall must be invisible to healthz (prober-only signal)"
+        page = pages[0]
+        assert page["kind"] == "obs_alert" \
+            and page["reason"] == "slo_fast_burn" \
+            and page["severity"] == "page", page
+        detail = page["detail"]
+        assert detail["slo"] == "availability", detail
+        assert tracing.valid_trace_id(detail.get("trace_id", "")), \
+            f"page must carry the failing probe's trace id: {detail}"
+        time.sleep(1.5)         # burn continues; the latch must hold
+        assert len(pages) == 1, \
+            f"edge latch failed: {len(pages)} pages for one burst"
+
+        # -- recovery: latch clears, budget stops draining ---------
+        for s in stubs:
+            s.behavior.pop("line_delay_s", None)
+        wait_for(lambda: not any(r.get("page_firing")
+                                 for r in engine.evaluate()),
+                 timeout=30, what="page latch to clear")
+        rec = next(r for r in engine.evaluate()
+                   if r["name"] == "availability")
+        budget_at_clear = rec["budget_remaining"]
+        time.sleep(1.0)
+        rec = next(r for r in engine.evaluate()
+                   if r["name"] == "availability")
+        assert rec["budget_remaining"] >= budget_at_clear - 1e-9, \
+            (rec["budget_remaining"], budget_at_clear)
+        assert len(pages) == 1, \
+            f"re-paged after recovery: {len(pages)}"
+        wait_for(lambda: any(r.get("name") == "availability"
+                             and "budget_remaining" in r
+                             for r in slo_records),
+                 what="obs_slo records on the emit cadence")
+    finally:
+        server.drain()
+        hook.close()
+        receiver.shutdown()
+        receiver.server_close()
+        for s in stubs:
+            s.close()
+        os.unlink(policy_path)
+
+
 def leg_real_engine():
     """Slow leg (--real): two real serve children, --chaos
     kill@tokens=N:replica=0 — a real SIGKILL of a real engine
@@ -434,7 +596,9 @@ def main() -> int:
             ("journal cap exceeded -> honest error frame",
              leg_journal_cap),
             ("trace context propagated across failover",
-             leg_trace_propagation)]
+             leg_trace_propagation),
+            ("slo closed loop: stall -> one page -> recovery",
+             leg_slo_closed_loop)]
     if real:
         legs.append(("real engine: SIGKILL mid-stream, no error "
                      "frame", leg_real_engine))

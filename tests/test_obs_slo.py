@@ -1,0 +1,424 @@
+"""SLO engine + prober: burn-rate math on synthetic SLI streams.
+
+Everything here drives tpunet/obs/slo.py with a FAKE clock — exact
+budget arithmetic, the multi-window edge latch (one page per burst,
+re-page on relapse), clock-skew and empty-window behavior, and the
+prober's golden-mismatch -> correctness-breach path — so the chaos
+smoke (scripts/serve_chaos_smoke.py SLO leg) can stay the only place
+real sockets and real time are involved.
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpunet.obs.registry import MemorySink, Registry
+from tpunet.obs.slo import (DEFAULT_POLICY, SloEngine, SloPolicyError,
+                            build_slo_record, load_policy)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def make_engine(policy, clock):
+    registry = Registry()
+    sink = MemorySink()
+    registry.add_sink(sink)
+    specs = load_policy_dict(policy)
+    engine = SloEngine(specs, registry=registry, clock=clock)
+    return engine, registry, sink
+
+
+def load_policy_dict(policy: dict):
+    """Parse an inline policy dict through the same validation path
+    as a file (round-trip through json)."""
+    import tempfile
+    fd, path = tempfile.mkstemp(suffix=".json")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(policy, f)
+        return load_policy(path)
+    finally:
+        os.unlink(path)
+
+
+AVAIL_POLICY = {"slos": [
+    {"name": "availability", "sli": "availability", "objective": 0.9,
+     "compliance_window_s": 1000,
+     "page": {"long_s": 100, "short_s": 20, "burn": 2.0},
+     "ticket": {"long_s": 400, "short_s": 50, "burn": 1.0}}]}
+
+
+def pages_of(sink, severity="page"):
+    return [r for r in sink.records if r.get("kind") == "obs_alert"
+            and r.get("severity") == severity
+            and str(r.get("reason", "")).startswith("slo_")]
+
+
+# -- policy loading ------------------------------------------------------
+
+
+def test_default_policy_loads_and_matches_docs_slos_json():
+    """docs/slos.json is the commented, operator-editable copy of
+    DEFAULT_POLICY — the two must parse to identical specs."""
+    assert load_policy("") == load_policy(
+        os.path.join(REPO, "docs", "slos.json"))
+    names = [s.name for s in load_policy("")]
+    assert names == [s["name"] for s in DEFAULT_POLICY["slos"]]
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda s: s.update(name="Bad-Name"), "lowercase"),
+    (lambda s: s.update(sli="uptime"), "sli"),
+    (lambda s: s.update(objective=1.0), "objective"),
+    (lambda s: s.update(objective="high"), "objective"),
+    (lambda s: s.update(compliance_window_s=0), "compliance_window_s"),
+    (lambda s: (s.pop("page"), s.pop("ticket")), "at least one"),
+    (lambda s: s["page"].update(short_s=500), "short_s"),
+    (lambda s: s["page"].update(burn=0), "burn"),
+])
+def test_policy_validation_is_loud(mutate, needle):
+    policy = json.loads(json.dumps(AVAIL_POLICY))
+    mutate(policy["slos"][0])
+    with pytest.raises(SloPolicyError, match=needle):
+        load_policy_dict(policy)
+
+
+def test_latency_sli_requires_threshold():
+    policy = {"slos": [{"name": "ttft", "sli": "latency_ttft",
+                        "objective": 0.99, "compliance_window_s": 100,
+                        "page": {"long_s": 10, "short_s": 5,
+                                 "burn": 1.0}}]}
+    with pytest.raises(SloPolicyError, match="threshold_s"):
+        load_policy_dict(policy)
+
+
+def test_duplicate_names_rejected():
+    policy = {"slos": AVAIL_POLICY["slos"] * 2}
+    with pytest.raises(SloPolicyError, match="duplicate"):
+        load_policy_dict(policy)
+
+
+def test_comment_stripping_never_touches_strings(tmp_path):
+    p = tmp_path / "p.json"
+    p.write_text("// a full-line comment\n"
+                 + json.dumps(AVAIL_POLICY))
+    assert load_policy(str(p)) == load_policy_dict(AVAIL_POLICY)
+
+
+# -- exact budget arithmetic ---------------------------------------------
+
+
+def test_budget_arithmetic_exact():
+    clock = FakeClock()
+    engine, _, _ = make_engine(AVAIL_POLICY, clock)
+    # 100 events inside every window: 3 bad.
+    for i in range(100):
+        engine.note_request(ok=i >= 3, t=clock.advance(0.1))
+    (rec,) = engine.evaluate()
+    assert rec["events"] == 100 and rec["bad"] == 3
+    assert rec["error_rate"] == pytest.approx(0.03)
+    # budget rate = 1 - 0.9 = 0.1; spent fraction = 0.03 / 0.1.
+    assert rec["budget_remaining"] == pytest.approx(1.0 - 0.03 / 0.1)
+    # burn = error_rate / budget over each window; all events are
+    # inside both page windows here.
+    assert rec["page_burn_long"] == pytest.approx(0.3)
+    assert rec["page_burn_short"] == pytest.approx(0.3)
+    assert not rec.get("page_firing") and not rec.get("ticket_firing")
+
+
+def test_latency_threshold_judges_samples():
+    clock = FakeClock()
+    policy = {"slos": [{"name": "ttft", "sli": "latency_ttft",
+                        "objective": 0.9, "threshold_s": 1.0,
+                        "compliance_window_s": 1000,
+                        "page": {"long_s": 100, "short_s": 20,
+                                 "burn": 2.0}}]}
+    engine, _, _ = make_engine(policy, clock)
+    for s in (0.1, 0.2, 1.5, 0.3, 2.0):   # 2 of 5 over threshold
+        engine.note_latency("ttft", s, t=clock.advance(1.0))
+    (rec,) = engine.evaluate()
+    assert rec["events"] == 5 and rec["bad"] == 2
+    assert rec["threshold_s"] == pytest.approx(1.0)
+    assert rec["page_burn_long"] == pytest.approx((2 / 5) / 0.1)
+
+
+# -- edge latch: one page per burst, re-page on relapse ------------------
+
+
+def test_edge_latch_pages_once_then_repages_on_relapse():
+    clock = FakeClock()
+    engine, registry, sink = make_engine(AVAIL_POLICY, clock)
+    # Healthy baseline.
+    for _ in range(50):
+        engine.note_request(True, t=clock.advance(0.2))
+    engine.evaluate()
+    assert pages_of(sink) == []
+    # Burst: hard outage, evaluated every second — exactly one page
+    # (and one slow-burn ticket) despite many evaluations.
+    for _ in range(30):
+        engine.note_request(False, t=clock.advance(1.0))
+        engine.evaluate()
+    assert len(pages_of(sink)) == 1
+    page = pages_of(sink)[0]
+    assert page["reason"] == "slo_fast_burn"
+    assert page["slo"] == "availability"
+    assert page["burn_long"] >= 2.0
+    assert len(pages_of(sink, "ticket")) == 1
+    assert registry.snapshot()["slo_pages_total"] == 1
+    # Recovery: good traffic clears the short window, latch re-arms.
+    for _ in range(200):
+        engine.note_request(True, t=clock.advance(1.0))
+        engine.evaluate()
+    (rec,) = engine.evaluate()
+    assert not rec.get("page_firing")
+    assert len(pages_of(sink)) == 1, "recovery must not page"
+    # Relapse: a second burst is a SECOND page.
+    for _ in range(30):
+        engine.note_request(False, t=clock.advance(1.0))
+        engine.evaluate()
+    assert len(pages_of(sink)) == 2
+    assert engine.evaluate()[0]["pages_total"] == 2
+
+
+def test_page_and_ticket_latch_independently():
+    """A slow burn above the ticket threshold but below the page
+    threshold files a ticket and never pages."""
+    clock = FakeClock()
+    engine, _, sink = make_engine(AVAIL_POLICY, clock)
+    # ~15% errors: burn 1.5 — over ticket (1.0), under page (2.0).
+    # Errors sit at the END of each 20-event cycle so the warmup
+    # prefix never shows an all-bad window.
+    for i in range(400):
+        engine.note_request(ok=(i % 20) < 17, t=clock.advance(1.0))
+        engine.evaluate()
+    assert pages_of(sink) == []
+    assert len(pages_of(sink, "ticket")) >= 1
+    (rec,) = engine.evaluate()
+    assert rec.get("ticket_firing") and not rec.get("page_firing")
+
+
+# -- empty windows and clock skew ----------------------------------------
+
+
+def test_empty_window_holds_the_latch():
+    """Silence is not recovery: an active page must survive a window
+    with no events (wedged prober), and an idle engine must not page."""
+    clock = FakeClock()
+    engine, _, sink = make_engine(AVAIL_POLICY, clock)
+    (rec,) = engine.evaluate()       # no events at all: no verdict
+    assert "page_burn_long" not in rec and not rec.get("page_firing")
+    assert pages_of(sink) == []
+    # Burn hard -> page fires and latches.
+    for _ in range(30):
+        engine.note_request(False, t=clock.advance(1.0))
+        engine.evaluate()
+    assert len(pages_of(sink)) == 1
+    # Total silence long enough to empty every alert window: the
+    # latch HOLDS — still firing, no new page, not cleared.
+    clock.advance(500.0)
+    (rec,) = engine.evaluate()
+    assert rec.get("page_firing") == 1
+    assert len(pages_of(sink)) == 1
+    # Good traffic (actual recovery evidence) clears it.
+    for _ in range(30):
+        engine.note_request(True, t=clock.advance(1.0))
+        engine.evaluate()
+    assert not engine.evaluate()[0].get("page_firing")
+
+
+def test_future_stamped_events_never_crash():
+    """Clock skew: an event stamped ahead of the evaluation clock
+    lands in every window rather than vanishing or crashing."""
+    clock = FakeClock()
+    engine, _, _ = make_engine(AVAIL_POLICY, clock)
+    engine.note_request(False, t=clock.t + 3600.0)
+    engine.note_request(True, t=clock.t)
+    (rec,) = engine.evaluate()
+    assert rec["events"] == 2 and rec["bad"] == 1
+
+
+# -- probe verdicts ------------------------------------------------------
+
+
+CORRECT_POLICY = {"slos": [
+    {"name": "correctness", "sli": "correctness", "objective": 0.99,
+     "compliance_window_s": 1000,
+     "page": {"long_s": 60, "short_s": 10, "burn": 1.0}}]}
+
+
+def test_probe_golden_mismatch_breaches_correctness():
+    clock = FakeClock()
+    engine, _, sink = make_engine(CORRECT_POLICY, clock)
+    for _ in range(20):
+        engine.note_probe(ok=True, t=clock.advance(1.0))
+        engine.evaluate()
+    assert pages_of(sink) == []
+    # A bad weight rollout: available, fast, WRONG tokens.
+    for _ in range(10):
+        engine.note_probe(ok=True, mismatch=True,
+                          trace_id="feedc0dedeadbeef",
+                          t=clock.advance(1.0))
+        engine.evaluate()
+    assert len(pages_of(sink)) == 1
+    page = pages_of(sink)[0]
+    assert page["sli"] == "correctness"
+    assert page["trace_id"] == "feedc0dedeadbeef"
+    (rec,) = engine.evaluate()
+    assert rec["probe_requests"] == 30
+    assert rec["probe_mismatches"] == 10
+    assert rec["last_failed_trace"] == "feedc0dedeadbeef"
+
+
+def test_probe_failure_feeds_availability_not_correctness():
+    """A probe that never answered is an availability event only —
+    correctness is unjudgeable without tokens."""
+    clock = FakeClock()
+    policy = {"slos": AVAIL_POLICY["slos"] + CORRECT_POLICY["slos"]}
+    engine, _, _ = make_engine(policy, clock)
+    engine.note_probe(ok=False, trace_id="ab" * 8,
+                      t=clock.advance(1.0))
+    avail, correct = engine.evaluate()
+    assert avail["events"] == 1 and avail["bad"] == 1
+    assert correct["events"] == 0
+    assert engine.probe_failures == 1
+    assert engine.last_failed_trace == "ab" * 8
+
+
+# -- the prober itself (stdlib stub endpoint, no router) -----------------
+
+
+class _StubEndpoint:
+    """Minimal /v1/generate stream endpoint with mutable behavior."""
+
+    def __init__(self):
+        self.mode = "ok"          # ok | wrong | refuse
+        self.tokens = [1, 2, 3, 4]
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if stub.mode == "refuse":
+                    self.send_response(503)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                toks = (list(stub.tokens) if stub.mode == "ok"
+                        else [9] * len(stub.tokens))
+                lines = [json.dumps({"token": t, "i": i}).encode()
+                         + b"\n" for i, t in enumerate(toks)]
+                lines.append(json.dumps(
+                    {"done": True, "finish_reason": "length",
+                     "n_tokens": len(toks)}).encode() + b"\n")
+                body = b"".join(lines)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_prober_warmup_gate_and_golden_mismatch():
+    """Boot-window failures (before a golden exists) must not feed
+    the engine; the first clean probe arms it; wrong tokens after
+    that are a mismatch; post-arm failures DO burn."""
+    import types
+
+    from tpunet.router.prober import Prober
+
+    clock = FakeClock()
+    policy = {"slos": AVAIL_POLICY["slos"] + CORRECT_POLICY["slos"]}
+    engine = SloEngine(load_policy_dict(policy), registry=None,
+                       clock=clock)
+    registry = Registry()
+    stub = _StubEndpoint()
+    cfg = types.SimpleNamespace(probe_every_s=0.01,
+                                probe_timeout_s=2.0)
+    prober = Prober(cfg, engine, registry=registry,
+                    base_url=stub.url)
+    try:
+        stub.mode = "refuse"           # fleet not up yet
+        assert prober.probe_once() is False
+        assert engine.probe_requests == 0, \
+            "unarmed failures must not burn budget"
+        assert registry.snapshot()["prober_failures_total"] == 1
+
+        stub.mode = "ok"               # first clean probe arms it
+        assert prober.probe_once() is True
+        assert prober.golden == [1, 2, 3, 4]
+        assert engine.probe_requests == 1
+
+        stub.mode = "wrong"            # golden mismatch
+        assert prober.probe_once() is True
+        assert engine.probe_mismatches == 1
+        assert registry.snapshot()["prober_mismatch_total"] == 1
+        assert engine.last_failed_trace == prober.last_trace_id
+
+        stub.mode = "refuse"           # post-arm failure burns
+        assert prober.probe_once() is False
+        assert engine.probe_failures == 1
+        assert engine.probe_requests == 3
+    finally:
+        stub.close()
+
+
+# -- record shape --------------------------------------------------------
+
+
+def test_build_slo_record_shape():
+    rec = build_slo_record(name="x", sli="availability",
+                           objective=0.99, compliance_window_s=60.0,
+                           events=10, bad=1, error_rate=0.1,
+                           budget_remaining=0.5, page_burn_long=1.2,
+                           page_burn_short=3.4,
+                           page_burn_threshold=14.4,
+                           page_window_long_s=3600.0,
+                           page_window_short_s=300.0,
+                           page_firing=True, pages_total=2,
+                           probe_requests=5, probe_failures=1,
+                           probe_mismatches=0,
+                           last_failed_trace="ab" * 8)
+    assert rec["page_firing"] == 1 and rec["pages_total"] == 2
+    assert rec["probe_requests"] == 5
+    assert rec["last_failed_trace"] == "ab" * 8
+    assert json.loads(json.dumps(rec)) == rec
+    # Optional fields stay absent, not null.
+    lean = build_slo_record(name="x", sli="availability",
+                            objective=0.99,
+                            compliance_window_s=60.0)
+    for key in ("error_rate", "budget_remaining", "page_firing",
+                "pages_total", "probe_requests",
+                "last_failed_trace", "threshold_s"):
+        assert key not in lean

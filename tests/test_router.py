@@ -515,9 +515,33 @@ def test_router_end_to_end_two_replicas(tmp_path):
     assert windows, "no obs_router window records in metrics.jsonl"
     assert windows[-1]["final"]
     assert {"evict", "respawn"} <= {e["event"] for e in events}
-    # The respawned child booted from the AOT store.
-    aot_files = os.listdir(tmp_path / "aot")
-    assert any(f.endswith(".aotx") for f in aot_files)
+
+    # The respawned child booted from the AOT store — WHEN this
+    # platform can serialize executables at all. save() is
+    # best-effort by contract (tpunet/utils/cache.py): on jax builds
+    # where the serialize/deserialize roundtrip is unsupported the
+    # store stays empty by design, so gate the assertion on a local
+    # roundtrip probe instead of assuming population. The child also
+    # commits entries asynchronously w.r.t. serving, so poll rather
+    # than listing the directory once.
+    def _aot_roundtrip_supported() -> bool:
+        try:
+            import jax
+            from jax.experimental import serialize_executable
+            compiled = jax.jit(lambda x: x + 1).lower(1.0).compile()
+            blob, in_tree, out_tree = \
+                serialize_executable.serialize(compiled)
+            serialize_executable.deserialize_and_load(
+                blob, in_tree, out_tree)
+            return True
+        except Exception:  # noqa: BLE001 — unsupported platform
+            return False
+
+    if _aot_roundtrip_supported():
+        aot_dir = tmp_path / "aot"
+        _wait(lambda: aot_dir.is_dir() and any(
+            f.endswith(".aotx") for f in os.listdir(aot_dir)),
+            timeout=30, what=".aotx entries committed to the store")
 
     # -- fleet dashboard panel -----------------------------------------
     sys.path.insert(0, SCRIPTS)

@@ -96,13 +96,19 @@ def test_batched_sample_support_matches_filter_logits():
                                        top_k=k, top_p=p))[0]
         allowed.append(set(np.nonzero(np.isfinite(ref))[0].tolist()))
     seeds = np.asarray([7, 8, 9], np.int32)
+    # Rows are independent counter-based draws keyed by (seed, step)
+    # alone, so ONE [60*3, V] call draws bitwise the same tokens as 60
+    # separate [3, V] calls — without 60 eager dispatches of the whole
+    # sort/softmax/cumsum pipeline.
+    n_steps = 60
+    steps = np.repeat(np.arange(n_steps, dtype=np.int32), 3)
+    toks = np.asarray(batched_sample(
+        jnp.asarray(np.tile(logits, (n_steps, 1))),
+        np.tile(temp, n_steps), np.tile(top_k, n_steps),
+        np.tile(top_p, n_steps), np.tile(seeds, n_steps), steps))
     seen = [set(), set(), set()]
-    for step in range(60):
-        toks = np.asarray(batched_sample(
-            jnp.asarray(logits), temp, top_k, top_p, seeds,
-            np.full(3, step, np.int32)))
-        for i, t in enumerate(toks):
-            seen[i].add(int(t))
+    for j, t in enumerate(toks):
+        seen[j % 3].add(int(t))
     for i in range(3):
         assert seen[i] <= allowed[i], (params[i], seen[i] - allowed[i])
         # every filter keeps the argmax reachable
@@ -129,9 +135,14 @@ def test_batched_sample_deterministic_per_seed_and_step():
     seeds2[0] = 99
     c = np.asarray(batched_sample(logits, temp, zk, zp, seeds2, step0))
     np.testing.assert_array_equal(a[1:], c[1:])  # row independence
-    draws = {tuple(np.asarray(batched_sample(
-        logits, temp, zk, zp, seeds,
-        np.full(4, s, np.int32))).tolist()) for s in range(12)}
+    # 12 steps in one tiled call (rows independent, see the support
+    # test above), regrouped per step.
+    steps = np.repeat(np.arange(12, dtype=np.int32), 4)
+    tiled = np.asarray(batched_sample(
+        jnp.asarray(np.tile(np.asarray(logits), (12, 1))),
+        np.tile(temp, 12), np.tile(zk, 12), np.tile(zp, 12),
+        np.tile(seeds, 12), steps))
+    draws = {tuple(tiled[s * 4:(s + 1) * 4].tolist()) for s in range(12)}
     assert len(draws) > 1  # steps actually advance the stream
 
 

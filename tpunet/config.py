@@ -580,6 +580,23 @@ class RouterConfig:
     # replicas never saw trace context), but the seam and outcome are
     # on the books.
     trace_all_on_error: bool = True
+    # Synthetic canary probing (--probe-every-s, tpunet/router/
+    # prober.py, docs/serving.md "SLOs & probing"): every this many
+    # seconds the router issues a pinned greedy known-answer request
+    # through its OWN public endpoint — the full proxy path — and
+    # judges availability, TTFT/e2e latency, and bitwise golden-output
+    # correctness from the client's side, feeding the SLO engine's SLI
+    # streams. Each probe carries a minted always-sampled X-Trace-Id,
+    # so a failed or slow probe points at a replayable trace. 0 = off.
+    probe_every_s: float = 0.0
+    # SLO policy file (--slo-policy, docs/slos.json format:
+    # objectives + compliance windows + multi-window burn-rate alert
+    # rules; full-line // comments allowed). Arming it (or the
+    # prober) starts the tpunet/obs/slo.py engine: obs_slo records,
+    # slo_* gauges, and edge-latched fast-burn pages / slow-burn
+    # tickets through the obs_alert webhook path. Empty = built-in
+    # default policy when the prober is armed, otherwise off.
+    slo_policy: str = ""
     # Router identity on obs_router records (empty =
     # "router-<host>-<pid>").
     run_id: str = ""

@@ -28,6 +28,9 @@ STEP_KEEP = 512
 # a handful of slow-request exemplars for the dashboard panel.
 TRACE_KEEP = 256
 TRACE_SLOW_KEEP = 8
+# Per-stream burn-rate history (obs_slo, tpunet/obs/slo.py): enough
+# points for the dashboard's burn sparkline over recent emit windows.
+SLO_BURN_KEEP = 64
 
 
 class StreamState:
@@ -73,6 +76,13 @@ class StreamState:
         self.trace_records = 0
         self.trace_phases: deque = deque(maxlen=TRACE_KEEP)
         self.trace_slow: List[dict] = []
+        # SLO digest (``obs_slo``, tpunet/obs/slo.py): the last record
+        # per SLO name (budget remaining, burn rates, firing state,
+        # probe tallies) plus a bounded burn-rate history for the
+        # dashboard sparkline.
+        self.slo_records = 0
+        self.slo_last: Dict[str, dict] = {}
+        self.slo_burn: deque = deque(maxlen=SLO_BURN_KEEP)
         # Elasticity digest (tpunet/elastic/): membership changes are
         # part of the stream's judgeable history — a shrink explains a
         # throughput step-change the regression panel would otherwise
@@ -131,6 +141,14 @@ class StreamState:
             # fleet view can say which replica is crash-looping.
             self.crashes += 1
             self.last_crash = record
+        elif kind == "obs_slo":
+            self.slo_records += 1
+            name = str(record.get("name") or "")
+            if name:
+                self.slo_last[name] = record
+            burn = record.get("page_burn_long")
+            if burn is not None:
+                self.slo_burn.append((name, burn))
         elif kind == "obs_elastic":
             self.elastic_events += 1
             self.last_elastic = record
@@ -394,6 +412,91 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
         if last is not None:
             out["router_last_event"] = str(last.get("event", ""))
 
+    # -- error-budget / SLO rollup ---------------------------------------
+    # Latest obs_slo record per (stream, slo name): worst budget
+    # across the fleet, max burn rates, firing/page totals, probe
+    # tallies, and the burn sparkline + last failed probe trace the
+    # dashboard's error-budget panel renders.
+    slo_streams = [s for s in streams if s.slo_last]
+    if slo_streams:
+        out["fleet_slo_records_total"] = sum(s.slo_records
+                                             for s in slo_streams)
+        table: List[dict] = []
+        worst = None          # (budget_remaining, stream, name)
+        max_page = max_ticket = None
+        firing = 0
+        pages = tickets = 0
+        probe_req = probe_fail = probe_mis = 0
+        last_trace = ""
+        for s in slo_streams:
+            rows = [s.slo_last[n] for n in sorted(s.slo_last)]
+            for r in rows:
+                row = {"stream": s.key, "name": r.get("name"),
+                       "sli": r.get("sli"),
+                       "objective": r.get("objective")}
+                for k in ("budget_remaining", "error_rate",
+                          "page_burn_long", "page_burn_short",
+                          "ticket_burn_long", "page_firing",
+                          "ticket_firing", "pages_total",
+                          "tickets_total"):
+                    if r.get(k) is not None:
+                        row[k] = r[k]
+                table.append(row)
+                b = r.get("budget_remaining")
+                if b is not None and (worst is None or b < worst[0]):
+                    worst = (b, s.key, str(r.get("name")))
+                pb, tb = (r.get("page_burn_long"),
+                          r.get("ticket_burn_long"))
+                if pb is not None:
+                    max_page = pb if max_page is None \
+                        else max(max_page, pb)
+                if tb is not None:
+                    max_ticket = tb if max_ticket is None \
+                        else max(max_ticket, tb)
+                if r.get("page_firing") or r.get("ticket_firing"):
+                    firing += 1
+                pages += int(r.get("pages_total") or 0)
+                tickets += int(r.get("tickets_total") or 0)
+                if r.get("last_failed_trace"):
+                    last_trace = str(r["last_failed_trace"])
+            # Probe tallies are engine-level and duplicated on every
+            # SLO's record within a stream: count them once per
+            # stream (max over that stream's records), sum over
+            # streams.
+            probe_req += max((int(r.get("probe_requests") or 0)
+                              for r in rows), default=0)
+            probe_fail += max((int(r.get("probe_failures") or 0)
+                               for r in rows), default=0)
+            probe_mis += max((int(r.get("probe_mismatches") or 0)
+                              for r in rows), default=0)
+        if worst is not None:
+            out["fleet_slo_worst_budget_remaining"] = worst[0]
+            out["fleet_slo_worst_slo"] = f"{worst[1]}:{worst[2]}"
+        if max_page is not None:
+            out["fleet_slo_max_page_burn"] = max_page
+        if max_ticket is not None:
+            out["fleet_slo_max_ticket_burn"] = max_ticket
+        out["fleet_slo_firing"] = firing
+        out["fleet_slo_pages_total"] = pages
+        out["fleet_slo_tickets_total"] = tickets
+        if probe_req:
+            out["fleet_slo_probe_requests_total"] = probe_req
+            out["fleet_slo_probe_failures_total"] = probe_fail
+            out["fleet_slo_probe_mismatches_total"] = probe_mis
+        if last_trace:
+            out["fleet_slo_last_failed_trace"] = last_trace
+        out["slo_table"] = table
+        # Burn sparkline: the worst-budget stream's recent
+        # page-burn-rate history (values only, oldest first).
+        spark_stream = slo_streams[0]
+        if worst is not None:
+            for s in slo_streams:
+                if s.key == worst[1]:
+                    spark_stream = s
+                    break
+        out["slo_burn_spark"] = [round(float(b), 4) for _, b
+                                 in list(spark_stream.slo_burn)]
+
     # -- per-stream table ------------------------------------------------
     for s in streams:
         row: dict = {"stream": s.key, "records": s.records,
@@ -429,6 +532,17 @@ def fleet_rollup(streams: List[StreamState]) -> dict:
             if s.last_router_event is not None:
                 row["router_last_event"] = str(
                     s.last_router_event.get("event", ""))
+        if s.slo_last:
+            budgets = [(r.get("budget_remaining"), n)
+                       for n, r in s.slo_last.items()
+                       if r.get("budget_remaining") is not None]
+            if budgets:
+                b, n = min(budgets)
+                row["slo_worst_budget_remaining"] = b
+                row["slo_worst"] = n
+            if any(r.get("page_firing") or r.get("ticket_firing")
+                   for r in s.slo_last.values()):
+                row["slo_firing"] = 1
         if s.last_serve is not None:
             sv = s.last_serve
             for field in ("queue_depth", "active_slots", "slots",

@@ -185,6 +185,11 @@ def _serve_parts(summary: dict, key: str) -> List[merge.Part]:
     return [(s, int(n), bool(sat)) for s, n, sat in raw]
 
 
+def _trace_parts(summary: dict, key: str) -> List[merge.Part]:
+    raw = (summary.get("trace") or {}).get(f"{key}_parts") or []
+    return [(s, int(n), bool(sat)) for s, n, sat in raw]
+
+
 def compare_summaries(a: dict, b: dict, *,
                       quantiles: Sequence[float] = QUANTILES,
                       tolerance: float = DEFAULT_TOLERANCE) -> dict:
@@ -241,6 +246,16 @@ def compare_summaries(a: dict, b: dict, *,
             row = quantile_verdict(sp_a, sp_b, q)
             if row is not None:
                 metrics.append({"metric": f"serve_{key}_p{q:g}_s",
+                                **row})
+    # Trace-phase quantiles (store.TRACE_PHASES): the TTFT
+    # decomposition, so a serve regression names the phase it lives
+    # in (queue grew vs prefill grew) instead of just the symptom.
+    for key in ("queue", "prefill", "first_decode"):
+        tp_a, tp_b = _trace_parts(a, key), _trace_parts(b, key)
+        for q in quantiles:
+            row = quantile_verdict(tp_a, tp_b, q)
+            if row is not None:
+                metrics.append({"metric": f"trace_{key}_p{q:g}_s",
                                 **row})
     out["metrics"] = metrics
     out["regressions"] = sum(

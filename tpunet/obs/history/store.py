@@ -40,6 +40,15 @@ INDEX_NAME = "history.jsonl"
 EPOCH_WINDOWS_KEEP = 64
 #: Same bound for retained serve windows.
 SERVE_WINDOWS_KEEP = 64
+#: Per-phase cap on retained trace samples: a deterministic stride
+#: over the run's full sorted span population (the striding term of
+#: ``merge.part_rank_error`` covers it — no reservoir randomness).
+TRACE_SAMPLE_CAP = 256
+
+#: The per-phase TTFT decomposition obs_trace replica-role spans
+#: carry (docs/metrics_schema.md "obs_trace"): admission wait,
+#: prefill device time, prefill-done -> first token out.
+TRACE_PHASES = ("queue", "prefill", "first_decode")
 
 
 def summarize_run(records: List[dict], source: str = "") -> dict:
@@ -133,6 +142,37 @@ def summarize_run(records: List[dict], source: str = "") -> dict:
                 sv[f"{key}_parts"] = [
                     [list(s), n, bool(sat)] for s, n, sat in parts]
         summary["serve"] = sv
+
+    # Per-phase TTFT decomposition from replica-role trace spans:
+    # where a regression LIVES (admission wait vs prefill vs first
+    # decode), not just that TTFT moved. Spans are raw scalars per
+    # record, so the part is built here: full sorted population,
+    # stride-capped, count = true span count (compare.py merges it
+    # through the same DKW machinery as step-time/serve samples).
+    spans = [r for r in records if r.get("kind") == "obs_trace"
+             and r.get("role") == "replica"]
+    if spans:
+        tr: dict = {"spans": len(spans)}
+        for phase in TRACE_PHASES:
+            vals = sorted(float(r[f"{phase}_s"]) for r in spans
+                          if r.get(f"{phase}_s") is not None)
+            if not vals:
+                continue
+            n = len(vals)
+            if n > TRACE_SAMPLE_CAP:
+                stride = n / TRACE_SAMPLE_CAP
+                vals = [vals[min(n - 1, int(i * stride))]
+                        for i in range(TRACE_SAMPLE_CAP)]
+            parts = [(vals, n, False)]
+            merged = merge.merged_quantiles(parts, (50, 90, 99))
+            tr[f"{phase}_p50_s"] = round(merged[50], 6)
+            tr[f"{phase}_p90_s"] = round(merged[90], 6)
+            tr[f"{phase}_p99_s"] = round(merged[99], 6)
+            tr[f"{phase}_rank_err"] = round(
+                merge.rank_error_bound(parts), 4)
+            tr[f"{phase}_parts"] = [
+                [list(s), cnt, bool(sat)] for s, cnt, sat in parts]
+        summary["trace"] = tr
 
     if alerts:
         by_reason: Dict[str, int] = {}

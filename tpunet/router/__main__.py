@@ -116,6 +116,25 @@ def build_argparser():
                         "(default on): one router-hop obs_trace "
                         "record for any request that fails over or "
                         "errors, even below the sample rate")
+    p.add_argument("--probe-every-s", type=float,
+                   default=d.probe_every_s, metavar="S",
+                   help="synthetic canary prober cadence (tpunet/"
+                        "router/prober.py): issue a pinned greedy "
+                        "known-answer request through the router's "
+                        "own endpoint every S seconds, judging "
+                        "availability/latency/bitwise-golden "
+                        "correctness into the SLO engine's SLI "
+                        "streams; every probe carries a minted "
+                        "always-sampled X-Trace-Id (0 = off)")
+    p.add_argument("--slo-policy", default=d.slo_policy,
+                   metavar="FILE",
+                   help="SLO policy JSON (docs/slos.json format; "
+                        "full-line // comments ok): arms the "
+                        "tpunet/obs/slo.py engine — obs_slo records, "
+                        "slo_* gauges, edge-latched fast-burn pages / "
+                        "slow-burn tickets via the obs_alert webhook "
+                        "path (empty = built-in defaults when "
+                        "--probe-every-s is set)")
     p.add_argument("--request-timeout-s", type=float,
                    default=d.request_timeout_s)
     p.add_argument("--emit-every-s", type=float, default=d.emit_every_s,
@@ -198,6 +217,8 @@ def build_router_config(args):
         chaos=args.chaos,
         trace_sample=args.trace_sample,
         trace_all_on_error=args.trace_all_on_error,
+        probe_every_s=args.probe_every_s,
+        slo_policy=args.slo_policy,
         run_id=args.run_id)
 
 
@@ -224,6 +245,16 @@ def build_server(args):
             split_by_replica(args.chaos)
         except ServeChaosError as e:
             print(f"python -m tpunet.router: error: {e}",
+                  file=sys.stderr, flush=True)
+            raise SystemExit(2)
+    if args.slo_policy:
+        # A malformed SLO policy is a loud exit-2 at router boot, not
+        # an unguarded fleet discovered mid-incident.
+        from tpunet.obs.slo import SloPolicyError, load_policy
+        try:
+            load_policy(args.slo_policy)
+        except (OSError, SloPolicyError) as e:
+            print(f"python -m tpunet.router: error: --slo-policy: {e}",
                   file=sys.stderr, flush=True)
             raise SystemExit(2)
     serve_args = list(args.serve_args)

@@ -66,6 +66,15 @@ class Router:
                 process_index=0, host=socket.gethostname())
         self._clock = clock
         self.policy = AutoscalePolicy(cfg, clock=clock)
+        # SLO engine (tpunet/obs/slo.py): armed by --slo-policy and/or
+        # the canary prober; None keeps the whole path zero-cost.
+        self.slo = None
+        if getattr(cfg, "slo_policy", "") \
+                or getattr(cfg, "probe_every_s", 0.0) > 0:
+            from tpunet.obs.slo import SloEngine, load_policy
+            self.slo = SloEngine(
+                load_policy(getattr(cfg, "slo_policy", "")),
+                registry=self.registry, clock=clock)
         # Mid-stream failover journal (tpunet/router/journal.py):
         # owned here so the drain path can wait for in-flight
         # failovers instead of orphaning them with the frontend.
@@ -147,8 +156,13 @@ class Router:
         self.registry.counter("router_rerouted_total").inc()
         rep.note_failed()
 
-    def note_rejected(self) -> None:
+    def note_rejected(self, *, synthetic: bool = False) -> None:
+        """No routable replica. ``synthetic`` marks the prober's own
+        traffic — the prober self-judges via ``note_probe`` (with its
+        warmup gate), so the passive feed skips it here too."""
         self.registry.counter("router_rejected_total").inc()
+        if self.slo is not None and not synthetic:
+            self.slo.note_request(False)
 
     def note_failover(self, rep: ReplicaHandle, *,
                       tokens: int) -> None:
@@ -163,8 +177,16 @@ class Router:
             cause="replica_failed_mid_stream",
             detail={"tokens_relayed": tokens}))
 
-    def observe_e2e(self, seconds: float) -> None:
+    def observe_e2e(self, seconds: float, *,
+                    synthetic: bool = False) -> None:
+        """One request finished end-to-end. ``synthetic`` marks the
+        prober's own traffic — it judges itself client-side and feeds
+        the SLO engine through ``note_probe``, so the passive feed
+        skipping it keeps every probe counted exactly once."""
         self.registry.histogram("router_e2e_s").observe(seconds)
+        if self.slo is not None and not synthetic:
+            self.slo.note_request(True)
+            self.slo.note_latency("e2e", seconds)
 
     def note_trace(self, record: dict) -> None:
         """One router-hop ``obs_trace`` span closed (sampled request
@@ -272,6 +294,11 @@ class Router:
         self._respawn_due(now)
         self._autoscale()
         self._export_gauges()
+        if self.slo is not None:
+            # Every round, not just on emit cadence: burn-rate pages
+            # must fire at probe-loop latency (the record bodies are
+            # discarded here; emit_record re-evaluates on its cadence).
+            self.slo.evaluate()
         if self.cfg.emit_every_s > 0 \
                 and now - self._last_emit >= self.cfg.emit_every_s:
             self.emit_record()
@@ -416,6 +443,9 @@ class Router:
         from tpunet.obs.flightrec.threads import THREADS
         THREADS.export_gauges(self.registry)
         self.registry.emit("obs_router", record)
+        if self.slo is not None:
+            for slo_record in self.slo.evaluate():
+                self.registry.emit("obs_slo", slo_record)
         self.registry.reset_window()
 
     # -- lifecycle -------------------------------------------------------

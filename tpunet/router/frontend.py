@@ -153,6 +153,7 @@ class RouterServer:
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
         self._serve_thread: Optional[threading.Thread] = None
+        self.prober = None
 
     def start(self) -> "RouterServer":
         self.router.start()
@@ -163,6 +164,19 @@ class RouterServer:
             target=self.httpd.serve_forever, daemon=True,
             name="tpunet-router-http")
         self._serve_thread.start()
+        cfg = self.router.cfg
+        if getattr(cfg, "probe_every_s", 0.0) > 0 \
+                and self.router.slo is not None:
+            # The canary probes the router's PUBLIC endpoint — the
+            # full proxy path — via loopback (the prober lives in the
+            # router process; a wildcard bind still answers there).
+            from tpunet.router.prober import Prober
+            host = cfg.host
+            if host in ("", "0.0.0.0", "::"):
+                host = "127.0.0.1"
+            self.prober = Prober(
+                cfg, self.router.slo, registry=self.registry,
+                base_url=f"http://{host}:{self.port}").start()
         return self
 
     def drain(self) -> None:
@@ -175,6 +189,8 @@ class RouterServer:
             return
         self._drained = True
         flightrec.record("router", "frontend drain")
+        if self.prober is not None:
+            self.prober.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         self.router.drain()
@@ -384,7 +400,7 @@ def _make_handler(server: RouterServer):
                     tracing.crumb("open", trace.trace_id, trace.hop,
                                   rep=rep.name)
                 return ("resp", resp, rep)
-            router.note_rejected()
+            router.note_rejected(synthetic=bool(body.get("probe")))
             code, payload = last_error or (
                 503, {"error": "no_replicas",
                       "detail": "no routable replica"})
@@ -407,6 +423,7 @@ def _make_handler(server: RouterServer):
             trace = (types.SimpleNamespace(
                 trace_id=tid, trace_sampled=sampled, hop=0)
                 if tid else None)
+            synthetic = bool(body.get("probe"))
             tried: set = set()
             while True:
                 opened = self._open_on_fleet(body, path, tried,
@@ -430,7 +447,8 @@ def _make_handler(server: RouterServer):
                         self._relay_stream(resp)
                     finally:
                         resp.close()
-                        router.observe_e2e(time.perf_counter() - t0)
+                        router.observe_e2e(time.perf_counter() - t0,
+                                           synthetic=synthetic)
                     return
                 # Non-stream: buffer the WHOLE body before the first
                 # client byte — a replica death mid-read is then fully
@@ -451,7 +469,8 @@ def _make_handler(server: RouterServer):
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
-                router.observe_e2e(time.perf_counter() - t0)
+                router.observe_e2e(time.perf_counter() - t0,
+                                   synthetic=synthetic)
                 return
 
         # -- streaming with mid-stream failover ------------------------
@@ -548,7 +567,9 @@ def _make_handler(server: RouterServer):
                                                          rep)
                     resp.close()
                     if outcome == "done":
-                        router.observe_e2e(time.perf_counter() - t0)
+                        router.observe_e2e(time.perf_counter() - t0,
+                                           synthetic=bool(
+                                               body.get("probe")))
                         self._close_trace(
                             entry, self._finish_reason or "done", t0)
                         return

@@ -62,6 +62,24 @@ def enable_persistent_compile_cache(directory: str | None = None) -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def _reset_compilation_cache_latch() -> None:
+    """Drop jax's once-per-process cache-usage latch.
+
+    ``compile_or_get_cached`` gates on ``is_cache_used()``, which
+    checks ``jax_enable_compilation_cache`` ONCE and latches the
+    answer for the life of the process — after any compile has run
+    with the cache enabled, flipping the flag off is silently ignored
+    for both reads and writes. ``reset_cache()`` clears the latch (and
+    the lazily-held cache handle) so the next compile re-evaluates the
+    flag. Best-effort: on a jax without it, the flag flip alone still
+    covers processes whose first compile is the serializable one."""
+    try:
+        from jax._src import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:  # noqa: BLE001 — private API moved/renamed
+        pass
+
+
 @contextlib.contextmanager
 def serializable_compile():
     """Compile with the persistent compilation cache OFF.
@@ -73,15 +91,23 @@ def serializable_compile():
     ``.lower().compile()`` of any program destined for ``save`` in
     this so the executable is built fresh and self-contained; the
     cache setting is restored on exit.
+
+    The flag flip alone is NOT enough: jax latches is-the-cache-used
+    at the process's first compile, so a boot that compiled anything
+    before this point would keep reading (and writing) the cache with
+    the flag down — the latch is reset on entry and again on exit so
+    both sides see their own flag honestly.
     """
     import jax
 
     prev = jax.config.jax_enable_compilation_cache
     jax.config.update("jax_enable_compilation_cache", False)
+    _reset_compilation_cache_latch()
     try:
         yield
     finally:
         jax.config.update("jax_enable_compilation_cache", prev)
+        _reset_compilation_cache_latch()
 
 
 class AotProgramStore:
