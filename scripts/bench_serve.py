@@ -22,6 +22,18 @@ bytes-budget mechanism pointed at serving capacity (exit 3 on a
 drop past tolerance; scripts/check_serve_budget.py is the standalone
 form). The >=2x-vs-sequential RELATIVE test lives in tests/test_serve;
 the absolute floor catches both paths slowing down together.
+
+``--prefix-frac`` switches to the shared-prompt workload that
+measures the prefix KV cache: that fraction of requests share the
+same ``--prefix-tokens``-long page-aligned prompt prefix (the system-
+prompt traffic shape), and the SAME workload runs cache-on and
+cache-off. The record reports ``prefill_tokens_per_request`` for both
+(the cache-on number must drop toward the suffix length),
+``prefix_hit_rate`` from the engine's own counters, and shared-prefix
+TTFT percentiles — ``shared_prefix_ttft_p99_ms`` is the budget-gated
+ceiling:
+
+    python scripts/bench_serve.py --prefix-frac 0.75 --prompt-len 64
 """
 
 from __future__ import annotations
@@ -397,6 +409,164 @@ def run_slots_sweep(args, model, variables) -> dict:
     }
 
 
+def _prefix_workload(concurrency, *, prompt_len, shared_len,
+                     prefix_frac, requests_per_client, vocab, seed=0):
+    """Per-client request plans for the shared-prompt workload —
+    built ONCE so the cache-on and cache-off engines serve the exact
+    same token streams. Each plan entry is (is_shared, prompt):
+    shared requests start with the common ``shared_len`` prefix and
+    differ only in the suffix."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, vocab, size=shared_len).astype(np.int32)
+    plans = []
+    for i in range(concurrency):
+        crng = np.random.default_rng(seed + 1000 + i)
+        plan = []
+        for _ in range(requests_per_client):
+            if crng.random() < prefix_frac:
+                sfx = crng.integers(
+                    0, vocab,
+                    size=prompt_len - shared_len).astype(np.int32)
+                plan.append((True, np.concatenate([shared, sfx])))
+            else:
+                plan.append((False, crng.integers(
+                    0, vocab, size=prompt_len).astype(np.int32)))
+        plans.append(plan)
+    return shared, plans
+
+
+def _run_prefix_variant(engine, shared, plans, *, new_tokens):
+    """Drive one engine through the shared-prompt plans (closed loop,
+    one client per plan) and report the prefix-relevant numbers from
+    the engine's OWN counters — the bench reads the same instruments
+    operators dashboard, not a shadow accounting."""
+    # Warm: compile programs and (when the cache is on) adopt the
+    # shared prefix, so the measurement sees steady-state hits rather
+    # than the one-time cold miss.
+    warm = np.concatenate([shared, np.zeros(1, np.int32)])
+    engine.submit(warm, max_new_tokens=2).result(timeout=600)
+    base = engine.registry.snapshot()
+    ttfts, shared_ttfts, e2es = [], [], []
+    errors = []
+    done_tokens = [0] * len(plans)
+
+    def client(i):
+        try:
+            for is_shared, p in plans[i]:
+                req = engine.submit(p, max_new_tokens=new_tokens)
+                req.result(timeout=600)
+                ttfts.append(req.ttft_s)
+                if is_shared:
+                    shared_ttfts.append(req.ttft_s)
+                e2es.append(req.e2e_s)
+                done_tokens[i] += len(req.tokens)
+        except Exception as e:  # noqa: BLE001 — report, don't hang
+            errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(plans))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    snap = engine.registry.snapshot()
+    n_requests = sum(len(p) for p in plans)
+    prefill = (snap.get("serve_prefill_tokens_total", 0)
+               - base.get("serve_prefill_tokens_total", 0))
+    lookups = (snap.get("serve_prefix_lookups_total", 0)
+               - base.get("serve_prefix_lookups_total", 0))
+    hits = (snap.get("serve_prefix_hits_total", 0)
+            - base.get("serve_prefix_hits_total", 0))
+    hit_tokens = (snap.get("serve_prefix_hit_tokens_total", 0)
+                  - base.get("serve_prefix_hit_tokens_total", 0))
+    total_tokens = sum(done_tokens)
+    return {
+        "requests": n_requests,
+        "errors": errors,
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / wall, 1) if wall else 0.0,
+        "prefill_tokens_per_request": round(prefill / n_requests, 2)
+        if n_requests else None,
+        "prefix_hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+        "prefix_hit_tokens": int(hit_tokens),
+        "ttft_p50_ms": ms(ttfts, 50),
+        "ttft_p99_ms": ms(ttfts, 99),
+        "shared_ttft_p50_ms": ms(shared_ttfts, 50),
+        "shared_ttft_p99_ms": ms(shared_ttfts, 99),
+        "e2e_p99_ms": ms(e2es, 99),
+    }
+
+
+def run_prefix_bench(args, model, variables, concurrency) -> dict:
+    """Shared-prompt A/B: the same workload (``--prefix-frac`` of
+    requests share a ``--prefix-tokens`` page-aligned prefix) through
+    a cache-on and a cache-off engine. The acceptance claim is in the
+    delta: cache-on ``prefill_tokens_per_request`` collapses toward
+    the suffix length while greedy output is identical math (the
+    parity tests own that half); ``shared_prefix_ttft_p99_ms`` is the
+    budget-gated latency ceiling."""
+    from tpunet.config import ServeConfig
+    from tpunet.serve import Engine
+
+    pt = args.kv_page_tokens
+    shared_len = args.prefix_tokens
+    if shared_len <= 0:
+        shared_len = (3 * args.prompt_len // 4) // pt * pt
+    if not 0 < shared_len < args.prompt_len:
+        print(f"--prompt-len {args.prompt_len} leaves no room for a "
+              f"page-aligned shared prefix at --kv-page-tokens {pt}; "
+              "raise --prompt-len or set --prefix-tokens explicitly",
+              file=sys.stderr)
+        sys.exit(2)
+    shared, plans = _prefix_workload(
+        concurrency, prompt_len=args.prompt_len, shared_len=shared_len,
+        prefix_frac=args.prefix_frac,
+        requests_per_client=args.requests_per_client,
+        vocab=args.vocab_size)
+    bucket = 1 << max(4, (args.prompt_len - 1).bit_length())
+    bucket = min(bucket, args.max_seq_len)
+    variants = {}
+    for label, on in (("cache_on", True), ("cache_off", False)):
+        cfg = ServeConfig(slots=args.slots,
+                          queue_max=max(64, 4 * args.slots),
+                          prefill_buckets=(bucket,), emit_every_s=0.0,
+                          prefix_cache=on, **_lever_overrides(args))
+        engine = Engine(model, variables, cfg).start()
+        try:
+            variants[label] = _run_prefix_variant(
+                engine, shared, plans, new_tokens=args.new_tokens)
+        finally:
+            engine.stop()
+    import jax
+    on, off = variants["cache_on"], variants["cache_off"]
+    out = {
+        "mode": "prefix",
+        "device": jax.devices()[0].device_kind,
+        "slots": args.slots,
+        "prompt_len": args.prompt_len,
+        "prefix_tokens": shared_len,
+        "prefix_frac": args.prefix_frac,
+        "new_tokens": args.new_tokens,
+        "kv_page_tokens": pt,
+        "concurrency": concurrency,
+        "cache_on": on,
+        "cache_off": off,
+        # headline numbers mirrored at top level for dashboards
+        "prefix_hit_rate": on["prefix_hit_rate"],
+        "prefill_tokens_per_request": on["prefill_tokens_per_request"],
+        "shared_prefix_ttft_p99_ms": on["shared_ttft_p99_ms"],
+    }
+    if on["prefill_tokens_per_request"] \
+            and off["prefill_tokens_per_request"]:
+        out["prefill_reduction_vs_cache_off"] = round(
+            off["prefill_tokens_per_request"]
+            / on["prefill_tokens_per_request"], 2)
+    return out
+
+
 def _get_json(url, timeout=10):
     import urllib.request
     with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -618,6 +788,16 @@ def main() -> None:
                     action=argparse.BooleanOptionalAction,
                     help="fused on-device sampling lever for A/Bs "
                          "(default: the ServeConfig default, ON)")
+    ap.add_argument("--prefix-frac", type=float, default=0.0,
+                    help="shared-prompt workload: this fraction of "
+                         "requests share one prompt prefix; > 0 "
+                         "switches to the prefix-cache A/B bench "
+                         "(cache-on vs cache-off over the SAME "
+                         "workload)")
+    ap.add_argument("--prefix-tokens", type=int, default=0,
+                    help="length of the shared prompt prefix (0 = "
+                         "largest page multiple <= 3/4 of "
+                         "--prompt-len)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="LM best checkpoint (default: random tiny "
                          "weights — throughput shape, not quality)")
@@ -712,6 +892,27 @@ def main() -> None:
         model = create_model(model_cfg)
         variables = init_variables(model, jax.random.PRNGKey(0),
                                    seq_len=16)
+
+    if args.prefix_frac > 0:
+        if args.paged_kv is False:
+            print("--no-paged-kv is incompatible with --prefix-frac "
+                  "(the prefix cache lives in the paged pool); drop "
+                  "one of the flags", file=sys.stderr)
+            sys.exit(2)
+        out = run_prefix_bench(args, model, variables, max(levels))
+        print(json.dumps(out, indent=1))
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        if args.enforce_budget:
+            from check_serve_budget import check_record, load_budget
+            ok, msgs = check_record(out, load_budget())
+            for m in msgs:
+                print(f"# {m}", file=sys.stderr, flush=True)
+            if not ok:
+                sys.exit(3)
+        return
 
     if args.slots_sweep:
         if args.paged_kv is False:

@@ -197,9 +197,13 @@ def collect_crash_records(tmpdir: str) -> list:
 
 def collect_serve_records() -> list:
     """obs_serve via the factored record builder (no engine/model
-    needed — the builder IS the record shape)."""
+    needed — the builder IS the record shape). The prefix-KV-cache
+    instruments are driven through the REAL host-side cache (lookup
+    miss -> insert -> hit -> pin/unpin -> evict), not hand-set, so a
+    renamed instrument fails here before it drifts from the doc."""
     from tpunet.obs.registry import MemorySink, Registry
     from tpunet.serve.engine import build_serve_record
+    from tpunet.serve.prefixcache import PrefixCache, chain_digests
 
     reg = Registry()
     reg.set_identity(run_id="serve-check", process_index=0, host="h")
@@ -213,9 +217,26 @@ def collect_serve_records() -> list:
                  "serve_prefill_s"):
         for i in range(5):
             reg.histogram(name).observe(0.01 * (i + 1))
+    cache = PrefixCache(page_tokens=4, capacity=4, registry=reg)
+    toks = list(range(8))
+    assert cache.lookup(toks, 2) == []            # miss
+    d0, d1 = chain_digests(toks, 4, 2)
+    n0 = cache.insert(d0, None, 0, 1)
+    n1 = cache.insert(d1, n0, 1, 2)
+    chain = cache.lookup(toks, 2)                 # hit, 2 pages
+    assert [n.page for n in chain] == [1, 2]
+    cache.pin(chain)
+    cache.unpin(chain)
+    assert cache.evict_one() == 2                 # leaf-first
+    # engine-side counters of the same family (COW copies, shared-FS
+    # spill/warm-start) — incremented exactly as the engine does
+    for name in ("serve_prefix_cow_total", "serve_prefix_spills_total",
+                 "serve_prefix_warm_loads_total"):
+        reg.counter(name).inc()
     record = build_serve_record(
         reg, queue_depth=1, active_slots=2, slots=4,
         uptime_s=12.0, window_s=3.0, final=True)
+    assert record["prefix_hit_rate"] > 0
     reg.emit("obs_serve", record)
     return sink.records
 

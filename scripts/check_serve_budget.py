@@ -28,6 +28,10 @@ Semantics mirror the bytes budget, with the direction flipped
   absolute floor exists to catch the failure mode the relative test
   cannot: both paths getting slower together.
 - A device kind with no budget entry passes with a note.
+- Mode-dispatched: ``cold_start`` records gate the AOT boot latency
+  ceiling (and aot < cold unconditionally); ``prefix`` records gate
+  the shared-prefix TTFT p99 ceiling and require the cache-on run to
+  prefill fewer tokens per request than cache-off outright.
 """
 
 from __future__ import annotations
@@ -113,6 +117,48 @@ def check_cold_start(record: Dict, key: str, entry: Dict,
     return ok, msgs
 
 
+def check_prefix(record: Dict, key: str, entry: Dict,
+                 tol: float) -> Tuple[bool, List[str]]:
+    """Gate a ``bench_serve.py --prefix-frac`` record: (a) the
+    cache-on run must prefill FEWER tokens per request than cache-off
+    outright — the compute elision the prefix cache exists for, on
+    the same workload — and (b) shared-prefix TTFT p99 stays under
+    the checked-in ceiling (a LATENCY: gated from ABOVE,
+    ceiling * (1 + tolerance))."""
+    on = (record.get("cache_on") or {}).get("prefill_tokens_per_request")
+    off = (record.get("cache_off") or {}).get(
+        "prefill_tokens_per_request")
+    msgs: List[str] = []
+    ok = True
+    if on is None or off is None:
+        return True, [f"{key}: prefix record has no cache-on/off "
+                      "prefill measurement; skipping"]
+    if on >= off:
+        ok = False
+        msgs.append(f"{key}: cache-on prefilled {on:.1f} tok/req, no "
+                    f"better than cache-off {off:.1f} [REGRESSION]")
+    else:
+        msgs.append(f"{key}: prefill_tokens_per_request {on:.1f} "
+                    f"cache-on vs {off:.1f} cache-off [OK]")
+    ceiling = entry.get("shared_prefix_ttft_p99_ms")
+    measured = (record.get("cache_on") or {}).get("shared_ttft_p99_ms")
+    if ceiling is None:
+        msgs.append(f"{key}: no shared_prefix_ttft_p99_ms ceiling; "
+                    "prefill-reduction only")
+        return ok, msgs
+    if measured is None:
+        msgs.append(f"{key}: record carries no shared_ttft_p99_ms "
+                    f"(ceiling {ceiling:.1f}); skipping")
+        return ok, msgs
+    limit = ceiling * (1.0 + tol)
+    within = measured <= limit
+    msgs.append(
+        f"{key}: shared_prefix_ttft_p99_ms measured {measured:.1f} vs "
+        f"ceiling {ceiling:.1f} (+{100 * tol:.0f}% tolerance -> "
+        f"limit {limit:.1f}) [{'OK' if within else 'REGRESSION'}]")
+    return ok and within, msgs
+
+
 def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
     """-> (ok, messages). ok is False only on a real throughput drop;
     a missing budget entry or an unmeasurable record passes with a
@@ -125,6 +171,8 @@ def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
                       "nothing to enforce"]
     if record.get("mode") == "cold_start":
         return check_cold_start(record, key, entry, tol)
+    if record.get("mode") == "prefix":
+        return check_prefix(record, key, entry, tol)
     ok_kv, kv_msgs = check_kv_bytes(record, key, entry, tol)
     budgeted = entry.get("tokens_per_s_per_slot")
     measured = tokens_per_s_per_slot(record)

@@ -33,10 +33,15 @@ with scripted deaths. Six legs:
    draining. Golden outputs stay bitwise-identical across replicas
    and across a mid-probe failover.
 
-``--real`` adds the slow leg: a supervised fleet of two real
+``--real`` adds the slow legs: (a) a supervised fleet of two real
 ``python -m tpunet.serve`` children with ``--chaos
 kill@tokens=N:replica=0`` (tpunet/serve/chaos.py) — SIGKILL of a real
-engine mid-stream, resumed through the real bucketed-prefill path.
+engine mid-stream, resumed through the real bucketed-prefill path;
+(b) the fleet-wide prefix warm start (PR 18): a shared-prefix request
+spills cached pages to a shared ``--prefix-store``, the serving
+replica is SIGKILLed by pid, and its RESPAWN adopts the fleet's
+prefix set at boot — the first shared-prefix request on the fresh
+process prefills only the suffix.
 
 Wired into scripts/run_checks.sh (fast set; --slow adds --real).
 Exit 0 = all legs pass.
@@ -580,6 +585,96 @@ def leg_real_engine():
         server.drain()
 
 
+def leg_prefix_warm_start():
+    """Slow leg (--real): fleet-wide prefix warm start across a
+    SIGKILL. Two real serve children share a ``--prefix-store``
+    directory; a shared-prefix request through replica r0 spills its
+    cached pages to the store; r0 is SIGKILLed by pid (from
+    ``GET /replicas``); the supervisor's respawn warm-loads the
+    fleet's prefix set at boot, so the FIRST shared-prefix request on
+    the fresh process prefills only the suffix."""
+    import signal
+    import tempfile
+
+    from tpunet.router.__main__ import build_argparser, build_server
+
+    def get_json(url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    tmp = tempfile.mkdtemp(prefix="serve-chaos-")
+    store = tempfile.mkdtemp(prefix="serve-prefix-")
+    argv = ["--spawn", "2", "--port", "0",
+            "--probe-interval-s", "0.2", "--probe-timeout-s", "2",
+            "--unhealthy-after", "2", "--boot-timeout-s", "240",
+            "--respawn-backoff-s", "0.5",  # we WANT the respawn here
+            "--emit-every-s", "0.5", "--min-replicas", "2",
+            "--max-replicas", "2", "--metrics-dir", tmp, "--",
+            "--checkpoint-dir", "", "--slots", "2",
+            "--prefill-buckets", "64", "--queue-max", "16",
+            "--max-new-tokens", "64", "--vit-hidden", "32",
+            "--vit-depth", "2", "--vit-heads", "2",
+            "--vocab-size", "256", "--max-seq-len", "256",
+            "--kv-page-tokens", "16", "--prefix-store", store]
+    server = build_server(build_argparser().parse_args(argv)).start()
+    router = server.router
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        wait_for(lambda: router.healthy_count() == 2, timeout=240,
+                 what="both replicas healthy (cold boot)")
+        rows = get_json(base + "/replicas")["replicas"]
+        r0 = next(r for r in rows if r["name"] == "r0")
+        old_pid = r0["pid"]
+
+        # Shared prefix = 2 full 16-token pages; hit r0 DIRECTLY so
+        # we know exactly which process cached + spilled the pages.
+        shared = [(i * 11 + 3) % 256 for i in range(32)]
+        lines = read_stream(r0["url"], {"tokens": shared + [5],
+                                        "max_new_tokens": 4,
+                                        "stream": True}, timeout=240)
+        assert lines[-1].get("done"), lines[-1]
+        wait_for(lambda: any(f.endswith(".pfx")
+                             for f in os.listdir(store)),
+                 timeout=30, what="prefix pages spilled to the store")
+        m0 = get_json(r0["url"] + "/metrics")
+        assert m0.get("serve_prefix_spills_total", 0) >= 2, m0
+
+        # SIGKILL the process that owns the cache; the probe loop
+        # evicts it and the supervisor respawns after the backoff.
+        os.kill(old_pid, signal.SIGKILL)
+
+        def respawned():
+            for r in get_json(base + "/replicas")["replicas"]:
+                if r["name"] == "r0":
+                    return (r["state"] == "healthy"
+                            and r.get("alive")
+                            and r.get("pid") not in (None, old_pid))
+            return False
+        wait_for(respawned, timeout=240,
+                 what="r0 respawned + healthy after SIGKILL")
+        rows = get_json(base + "/replicas")["replicas"]
+        r0 = next(r for r in rows if r["name"] == "r0")
+
+        # The fresh process adopted the fleet's prefix set at boot...
+        m1 = get_json(r0["url"] + "/metrics")
+        assert m1.get("serve_prefix_warm_loads_total", 0) >= 2, \
+            f"respawn did not warm-load the shared store: {m1}"
+        # ...so its FIRST shared-prefix request prefills suffix only.
+        before = m1.get("serve_prefill_tokens_total", 0)
+        lines = read_stream(r0["url"], {"tokens": shared + [9],
+                                        "max_new_tokens": 4,
+                                        "stream": True}, timeout=240)
+        assert lines[-1].get("done"), lines[-1]
+        m2 = get_json(r0["url"] + "/metrics")
+        delta = m2.get("serve_prefill_tokens_total", 0) - before
+        assert 0 < delta < len(shared), \
+            f"warm replica prefilled {delta} tokens for a " \
+            f"{len(shared)}-token cached prefix"
+        assert m2.get("serve_prefix_hits_total", 0) >= 1, m2
+    finally:
+        server.drain()
+
+
 def main() -> int:
     real = "--real" in sys.argv[1:]
     unknown = [a for a in sys.argv[1:] if a != "--real"]
@@ -602,6 +697,9 @@ def main() -> int:
     if real:
         legs.append(("real engine: SIGKILL mid-stream, no error "
                      "frame", leg_real_engine))
+        legs.append(("prefix warm start: SIGKILL -> respawn adopts "
+                     "shared store, suffix-only prefill",
+                     leg_prefix_warm_start))
     failures = []
     for name, fn in legs:
         try:

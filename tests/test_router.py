@@ -65,7 +65,12 @@ def test_affinity_key_session_wins_over_prompt():
     assert k1 == k2 and k1.startswith("p:")
     t1 = affinity_key({"tokens": [1, 2, 3, 99]}, 3)
     t2 = affinity_key({"tokens": [1, 2, 3, 7]}, 3)
-    assert t1 == t2 == "t:1,2,3"
+    # The router hashes the SAME digest the replicas' prefix KV cache
+    # keys its pages on — the fleet-wide warm-start contract.
+    from tpunet.serve.prefixcache.keys import token_prefix_digest
+    assert t1 == t2 == "t:" + token_prefix_digest([1, 2, 3], 3)
+    t3 = affinity_key({"tokens": [9, 2, 3]}, 3)
+    assert t3 != t1
     assert affinity_key({}, 16) is None
     assert affinity_key({"prompt": "x"}, 0) is None
 

@@ -7,7 +7,11 @@ deterministic per (seed, step)), page-recycling/fragmentation stress
 (churn until every page has been reused; no stale-KV bleed across slot
 reuse), pool-exhaustion preemption resuming token-identically, the
 int8 eval-parity gate, the effective-budget satellite, and AOT
-cold-start of the paged+fused program set.
+cold-start of the paged+fused program set. PR 18 extends the stress
+and parity coverage to the prefix KV cache: refcounted/COW page
+semantics, suffix-only prefill on hits, eviction under pool pressure,
+cache-on/off/dense greedy parity, and the shared-filesystem
+spill/warm-start round trip.
 """
 
 import numpy as np
@@ -186,13 +190,15 @@ def test_page_recycling_stress_no_stale_kv_bleed(tiny_lm):
     reuse; every request's greedy output must still match solo decode
     — a recycled page leaking its previous occupant's K/V would
     diverge immediately."""
-    eng = make_engine(tiny_lm, slots=2, kv_pages=8,
-                      kv_page_tokens=4).start()
+    eng = make_engine(tiny_lm, slots=2, kv_pages=8, kv_page_tokens=4,
+                      prefix_cache=False).start()
     try:
         wave = 0
         # Requests of 5-8 prompt + 8 new tokens span 4 pages each, so
         # two co-residents demand the WHOLE 8-page pool; LIFO
         # recycling alone would otherwise keep cold pages cold.
+        # prefix_cache=False pins the PR-12 contract: with no cache
+        # retaining prompt pages, release returns every page.
         while wave < 12 and (len(eng._kv_pages_touched)
                              < eng.kv_pages_usable or wave < 4):
             ps = prompts(4, rng_seed=100 + wave, lo=5, hi=9)
@@ -294,6 +300,222 @@ def test_paged_vs_dense_engine_outputs_identical(tiny_lm):
         finally:
             eng.stop()
     assert outs["paged"] == outs["dense"]
+
+
+# ---------------------------------------------------------------------------
+# prefix KV cache: refcounted content-addressed pages (PR 18)
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_trie_pin_release_evict_order():
+    """Host-side trie semantics in isolation: lookup walks the longest
+    cached chain; interleaved pin/release keeps refcounts exact (a
+    double-pinned node survives one release); eviction is leaf-first
+    (never orphans a cached chain) and LRU among evictable nodes."""
+    from tpunet.serve.prefixcache import PrefixCache, chain_digests, \
+        token_prefix_digest
+
+    c = PrefixCache(page_tokens=4, capacity=8)
+    toks = list(range(12))
+    d = chain_digests(toks, 4, 3)
+    n0 = c.insert(d[0], None, 0, 5)
+    n1 = c.insert(d[1], n0, 1, 6)
+    n2 = c.insert(d[2], n1, 2, 7)
+    assert [n.page for n in c.lookup(toks, 3)] == [5, 6, 7]
+    assert [n.page for n in c.lookup(toks, 2)] == [5, 6]
+    assert c.lookup([9] * 12, 3) == []
+    # every node pinned -> nothing evictable
+    c.pin([n0, n1, n2])
+    assert c.evict_one() is None
+    # releasing the leaf exposes exactly the leaf; interior nodes with
+    # children stay, so the surviving trie is always prefix-closed
+    c.unpin([n2])
+    assert c.evict_one() == 7
+    assert c.lookup(toks, 3) == [n0, n1]
+    c.unpin([n0, n1])
+    assert c.evict_one() == 6
+    assert c.evict_one() == 5
+    assert c.evict_one() is None and c.pages_cached == 0
+    # interleaved pin/release: two pins need two releases
+    m = c.insert(token_prefix_digest([3, 3, 3, 3], 4), None, 0, 2)
+    c.pin([m])
+    c.pin([m])
+    c.unpin([m])
+    assert c.evict_one() is None
+    c.unpin([m])
+    assert c.evict_one() == 2
+    # LRU: the older untouched root goes first
+    a = c.insert(token_prefix_digest([1] * 4, 4), None, 0, 3)
+    b = c.insert(token_prefix_digest([2] * 4, 4), None, 0, 4)
+    c.pin([a])
+    c.unpin([a])            # touches a after b's insert
+    assert c.evict_one() == 4
+    assert c.evict_one() == 3
+
+
+def test_prefix_hit_pins_pages_and_prefills_suffix_only(tiny_lm):
+    """A second request sharing the first two prompt pages must pin
+    them from the cache and prefill ONLY the suffix — measured by the
+    serve_prefill_tokens_total delta — while staying token-identical
+    to solo decode (stale or misattributed prefix K/V would diverge
+    immediately)."""
+    eng = make_engine(tiny_lm, slots=2, kv_pages=16,
+                      kv_page_tokens=4).start()
+    try:
+        rng = np.random.default_rng(13)
+        shared = rng.integers(0, TINY.vocab_size, size=8).astype(np.int32)
+        p1 = np.concatenate([shared, rng.integers(
+            0, TINY.vocab_size, size=3).astype(np.int32)])
+        p2 = np.concatenate([shared, rng.integers(
+            0, TINY.vocab_size, size=2).astype(np.int32)])
+        out1 = eng.submit(p1, max_new_tokens=5).result(timeout=120)
+        pre1 = eng.registry.snapshot()["serve_prefill_tokens_total"]
+        assert pre1 == p1.size          # cold request: full prefill
+        out2 = eng.submit(p2, max_new_tokens=5).result(timeout=120)
+        snap = eng.registry.snapshot()
+        assert snap["serve_prefill_tokens_total"] - pre1 == p2.size - 8
+        assert snap["serve_prefix_hits_total"] >= 1
+        assert snap["serve_prefix_hit_tokens_total"] >= 8
+        assert snap["serve_prefix_inserts_total"] >= 2
+        assert snap["serve_prefix_pages_cached"] >= 2
+    finally:
+        eng.stop()
+    assert out1 == solo_greedy(tiny_lm, p1, 5)
+    assert out2 == solo_greedy(tiny_lm, p2, 5)
+
+
+def test_prefix_cow_identical_prompt_and_divergence(tiny_lm):
+    """Copy-on-write at the divergence page: an identical page-aligned
+    prompt re-uses the full cached chain but COPIES the last page into
+    a private one (decode will write past it); a prompt diverging
+    INSIDE the second page pins only the first and re-prefills from
+    the divergence page without COW. Both stay solo-greedy-identical —
+    a COW copy sharing mutable state with the source would corrupt the
+    cached page for later hits."""
+    eng = make_engine(tiny_lm, slots=2, kv_pages=16,
+                      kv_page_tokens=4).start()
+    try:
+        rng = np.random.default_rng(17)
+        p = rng.integers(0, TINY.vocab_size, size=8).astype(np.int32)
+        out1 = eng.submit(p, max_new_tokens=6).result(timeout=120)
+        out2 = eng.submit(p, max_new_tokens=6).result(timeout=120)
+        cow = eng.registry.snapshot()["serve_prefix_cow_total"]
+        assert cow >= 1
+        q = p.copy()
+        q[5] = (int(q[5]) + 1) % TINY.vocab_size   # diverge in page 1
+        out3 = eng.submit(q, max_new_tokens=6).result(timeout=120)
+        snap = eng.registry.snapshot()
+        assert snap["serve_prefix_hits_total"] >= 2
+        assert snap["serve_prefix_cow_total"] == cow   # divergence != COW
+        # the COW'd source page is still served intact after both
+        out4 = eng.submit(p, max_new_tokens=6).result(timeout=120)
+    finally:
+        eng.stop()
+    assert out1 == out2 == out4 == solo_greedy(tiny_lm, p, 6)
+    assert out3 == solo_greedy(tiny_lm, q, 6)
+
+
+def test_prefix_churn_stress_refcounted_pages_no_stale_bleed(tiny_lm):
+    """The PR-12 recycling stress extended to the refcounted/COW
+    regime: with the prefix cache ON over a pool two co-residents can
+    exhaust, pages continuously migrate free list -> slot -> cache ->
+    (eviction) -> free list, repeated prompts hit cached pages, and
+    every request must STILL match solo decode — any stale K/V bleed
+    through a recycled or cached page diverges greedy output. At
+    quiesce every pool page is either free or cached-unpinned
+    (nothing leaks)."""
+    eng = make_engine(tiny_lm, slots=2, kv_pages=8,
+                      kv_page_tokens=4).start()
+    try:
+        for wave in range(8):
+            # seeds repeat across waves -> identical prompts recur and
+            # exercise hits/COW against pages that churned in between
+            ps = prompts(4, rng_seed=200 + wave % 3, lo=5, hi=9)
+            reqs = [eng.submit(p, max_new_tokens=8) for p in ps]
+            for p, r in zip(ps, reqs):
+                assert r.result(timeout=120) == \
+                    solo_greedy(tiny_lm, p, 8), f"wave {wave} diverged"
+        # a back-to-back repeat at quiesce must hit the cache
+        fixed = prompts(1, rng_seed=999, lo=8, hi=9)[0]
+        a = eng.submit(fixed, max_new_tokens=4).result(timeout=120)
+        b = eng.submit(fixed, max_new_tokens=4).result(timeout=120)
+        assert a == b == solo_greedy(tiny_lm, fixed, 4)
+        snap = eng.registry.snapshot()
+        assert snap["serve_prefix_evictions_total"] >= 1, \
+            "pool pressure never evicted a cached page"
+        assert snap["serve_prefix_hits_total"] >= 1
+        assert eng._prefix.pinned_pages() == 0
+        assert len(eng._free_pages) + eng._prefix.pages_cached \
+            == eng.kv_pages_usable, "a pool page leaked"
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_parity_on_off_dense(tiny_lm):
+    """Greedy output over a shared-prefix workload is identical with
+    the cache on, the cache off, and the dense (--no-paged-kv) path —
+    the cache is a pure compute-elision, never a math change."""
+    rng = np.random.default_rng(31)
+    shared = rng.integers(0, TINY.vocab_size, size=8).astype(np.int32)
+    ps = [np.concatenate([shared, rng.integers(
+        0, TINY.vocab_size, size=k).astype(np.int32)])
+        for k in (3, 2, 5, 1)]
+    outs = {}
+    for label, kw in (("cache", {}),
+                      ("nocache", {"prefix_cache": False}),
+                      ("dense", {"paged_kv": False})):
+        eng = make_engine(tiny_lm, slots=2, **kw).start()
+        try:
+            outs[label] = [eng.submit(p, max_new_tokens=5)
+                           .result(timeout=120) for p in ps]
+        finally:
+            eng.stop()
+    assert outs["cache"] == outs["nocache"] == outs["dense"]
+    for p, o in zip(ps, outs["cache"]):
+        assert o == solo_greedy(tiny_lm, p, 5)
+
+
+def test_prefix_spill_and_warm_start_roundtrip(tmp_path, tiny_lm):
+    """Shared-filesystem warm start: replica 1 spills its adopted
+    prefix pages write-through; a FRESH replica 2 sharing the store
+    directory adopts them at boot (warm_loads), and its very first
+    shared-prefix request prefills only the suffix while staying
+    solo-greedy-identical — the full pickle -> fs -> pool round trip
+    must reproduce the K/V rows bitwise."""
+    from tpunet.serve.prefixcache import build_prefix_store
+
+    model, variables = tiny_lm
+    cfg = ServeConfig(slots=2, queue_max=8, prefill_buckets=(16,),
+                      default_max_new_tokens=6, emit_every_s=0.0,
+                      kv_pages=12, kv_page_tokens=4)
+    store = build_prefix_store(str(tmp_path), TINY, cfg)
+    rng = np.random.default_rng(21)
+    shared = rng.integers(0, TINY.vocab_size, size=8).astype(np.int32)
+    p1 = np.concatenate([shared, rng.integers(
+        0, TINY.vocab_size, size=3).astype(np.int32)])
+    eng = Engine(model, variables, cfg, prefix_store=store).start()
+    try:
+        out1 = eng.submit(p1, max_new_tokens=5).result(timeout=120)
+    finally:
+        eng.stop()
+    assert out1 == solo_greedy(tiny_lm, p1, 5)
+    assert eng.registry.snapshot()["serve_prefix_spills_total"] >= 2
+    assert any(f.name.endswith(".pfx") for f in tmp_path.iterdir())
+
+    eng2 = Engine(model, variables, cfg, prefix_store=store).start()
+    try:
+        assert eng2.registry.snapshot()[
+            "serve_prefix_warm_loads_total"] >= 2
+        p2 = np.concatenate([shared, rng.integers(
+            0, TINY.vocab_size, size=2).astype(np.int32)])
+        out2 = eng2.submit(p2, max_new_tokens=5).result(timeout=120)
+    finally:
+        eng2.stop()
+    assert out2 == solo_greedy(tiny_lm, p2, 5)
+    snap2 = eng2.registry.snapshot()
+    assert snap2["serve_prefix_hits_total"] >= 1
+    assert snap2["serve_prefix_hit_tokens_total"] >= 8
+    # the warmed replica never prefilled the shared prefix at all
+    assert snap2["serve_prefill_tokens_total"] == p2.size - 8
 
 
 # ---------------------------------------------------------------------------

@@ -444,6 +444,27 @@ class ServeConfig:
     # tokens cross the host boundary. Greedy output is token-identical
     # either way (parity-tested).
     device_sampling: bool = True
+    # Prefix KV cache (default ON with paged_kv; --no-prefix-cache
+    # disables): finished prefill pages stay in the pool as immutable,
+    # content-addressed, refcounted objects keyed by token-prefix
+    # digest at page granularity. Admission pins the longest cached
+    # page-aligned prefix into the new slot's table and re-prefills
+    # only the suffix (COW at the divergence page); LRU-evicted under
+    # pool pressure — docs/serving.md "Prefix KV cache".
+    prefix_cache: bool = True
+    # Pool pages the prefix cache may hold (pinned + idle); 0 = auto
+    # (half the usable pool). Bounding it below the pool keeps paying
+    # slots from ever being starved by cached pages.
+    prefix_cache_pages: int = 0
+    # Shared-filesystem prefix spill/warm-start (--prefix-store DIR):
+    # freshly-cached pages publish to DIR (content-digest tmp+rename,
+    # flock first-writer-wins — the AOT store's commit discipline via
+    # tpunet/utils/fsatomic.py), and a respawned or scaled-up replica
+    # adopts the fleet's prefix set at boot so its first shared-prefix
+    # request prefills only the suffix. Entries are scoped by model
+    # config + kv levers + runtime, so a lever change is a clean miss.
+    # Empty = per-replica cache only.
+    prefix_store: str = ""
     # Per-request caps: default/max new tokens, and a wall-clock
     # deadline after which a request is cancelled and its slot freed
     # (0 = no deadline).

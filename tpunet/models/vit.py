@@ -229,7 +229,16 @@ class Attention(nn.Module):
 
         int8 pages carry a float32 scale per page row (written in the
         same scatter) and dequantize on gather. The engine owns page
-        allocation; this method never sees a free list."""
+        allocation; this method never sees a free list.
+
+        Prefix-cache safety contract (PR 18): the scatter only ever
+        touches rows for the NEW tokens of this step — flat indices
+        derived from ``positions + arange(t)``, i.e. positions >= the
+        row's prefill start. Pages the engine pinned from the prefix
+        cache cover positions strictly BELOW start, so shared
+        refcounted pages are bitwise-frozen by construction; the
+        engine enforces copy-on-write before any position inside a
+        shared page could land in the scatter."""
         b, t = q.shape[0], q.shape[1]
         heads, head_dim = k.shape[2], k.shape[3]
         pt = paged_kv.page_tokens

@@ -30,7 +30,13 @@ def affinity_key(body: dict, prefix: int) -> Optional[str]:
     """The affinity hash key for one /v1/generate body, or None when
     the request has nothing to be affine on. An explicit ``session``
     wins; otherwise the first ``prefix`` prompt units (tokens or
-    UTF-8 bytes) identify the shared prefix."""
+    UTF-8 bytes) identify the shared prefix.
+
+    Token-prefix requests hash the SAME digest the replicas' prefix
+    KV cache keys its pages on (tpunet/serve/prefixcache/keys.py), so
+    the digest the router routes by and the digest the cache hits on
+    agree by construction: shared-prefix traffic lands where those
+    exact pages are warm."""
     session = body.get("session")
     if session:
         return f"s:{session}"
@@ -38,7 +44,8 @@ def affinity_key(body: dict, prefix: int) -> Optional[str]:
         return None
     tokens = body.get("tokens")
     if isinstance(tokens, list) and tokens:
-        return "t:" + ",".join(str(t) for t in tokens[:prefix])
+        from tpunet.serve.prefixcache.keys import token_prefix_digest
+        return "t:" + token_prefix_digest(tokens, prefix)
     prompt = body.get("prompt")
     if isinstance(prompt, str) and prompt:
         return "p:" + prompt.encode("utf-8")[:prefix].hex()

@@ -100,6 +100,29 @@ def build_argparser():
                         "quantizes per written token row (float32 "
                         "scale stored with the page, eval-parity-"
                         "gated) — halves page cost again")
+    p.add_argument("--prefix-cache", default=d.prefix_cache,
+                   action=argparse.BooleanOptionalAction,
+                   help="prefix KV cache (default on, paged only): "
+                        "finished prefill pages stay in the pool as "
+                        "refcounted content-addressed objects; a new "
+                        "request pins its longest cached page-aligned "
+                        "prefix and prefills only the suffix (COW at "
+                        "the divergence page, LRU-evicted under pool "
+                        "pressure)")
+    p.add_argument("--prefix-cache-pages", type=int,
+                   default=d.prefix_cache_pages,
+                   help="pool pages the prefix cache may hold (0 = "
+                        "half the usable pool) — bounded below the "
+                        "pool so cached pages never starve paying "
+                        "slots")
+    p.add_argument("--prefix-store", default=d.prefix_store,
+                   metavar="DIR",
+                   help="shared-filesystem prefix spill/warm-start: "
+                        "cached pages publish under DIR (first-writer-"
+                        "wins, like --aot-cache) and a respawned "
+                        "replica adopts the fleet's prefix set at "
+                        "boot; entries scoped by model config + kv "
+                        "levers so a lever change is a clean miss")
     p.add_argument("--device-sampling", default=d.device_sampling,
                    action=argparse.BooleanOptionalAction,
                    help="batched temperature/top-k/top-p sampling "
@@ -228,6 +251,9 @@ def build_server(args):
         paged_kv=args.paged_kv, kv_pages=args.kv_pages,
         kv_page_tokens=args.kv_page_tokens, kv_dtype=args.kv_dtype,
         device_sampling=args.device_sampling,
+        prefix_cache=args.prefix_cache,
+        prefix_cache_pages=args.prefix_cache_pages,
+        prefix_store=args.prefix_store,
         default_max_new_tokens=args.max_new_tokens,
         max_new_tokens_cap=args.max_new_tokens_cap,
         default_deadline_s=args.deadline_s,
@@ -256,8 +282,13 @@ def build_server(args):
     if cfg.aot_cache and mesh is None:
         from tpunet.serve.engine import build_aot_store
         aot_store = build_aot_store(cfg.aot_cache, model_cfg, cfg)
+    prefix_store = None
+    if cfg.prefix_store and cfg.prefix_cache and cfg.paged_kv:
+        from tpunet.serve.prefixcache import build_prefix_store
+        prefix_store = build_prefix_store(cfg.prefix_store, model_cfg,
+                                          cfg)
     engine = Engine(model, variables, cfg, mesh=mesh,
-                    aot_store=aot_store)
+                    aot_store=aot_store, prefix_store=prefix_store)
     if engine.aot_status:
         print(f"aot warm-start: {engine.aot_status}", flush=True)
     registry = engine.registry

@@ -30,6 +30,8 @@ import os
 import pickle
 import tempfile
 
+from tpunet.utils import fsatomic
+
 
 def cache_dir() -> str:
     """The shared cache directory (honoring JAX's own env var) — also
@@ -195,40 +197,10 @@ class AotProgramStore:
             serialize_executable.deserialize_and_load(
                 blob, in_tree, out_tree)
             payload = pickle.dumps((blob, in_tree, out_tree))
-            os.makedirs(self.directory, exist_ok=True)
-            path = self._path(name, shape_tag)
-            with self._commit_lock(path):
-                if os.path.exists(path):
-                    # Another host/process committed this key while we
-                    # were compiling: dedup — never rewrite an entry
-                    # a replica may be deserializing right now.
-                    return True
-                content = hashlib.sha256(payload).hexdigest()[:16]
-                tmp = path + f".{content}.tmp"
-                with open(tmp, "wb") as f:
-                    f.write(payload)
-                os.replace(tmp, path)
-            return True
+            # First-writer-wins dedup + content-digest staging lives in
+            # fsatomic — the prefix KV spill store shares the identical
+            # commit discipline.
+            return fsatomic.publish_bytes(
+                self._path(name, shape_tag), payload)
         except Exception:  # noqa: BLE001
             return False
-
-    @staticmethod
-    @contextlib.contextmanager
-    def _commit_lock(path: str):
-        """``flock`` on ``<entry>.lock`` around the exists-check +
-        rename (advisory, NFS-visible where flock is supported). On
-        filesystems/platforms without flock the tmp+rename commit
-        alone still guarantees no torn entry — only the dedup check
-        loses its atomicity."""
-        lock_path = path + ".lock"
-        try:
-            import fcntl
-        except ImportError:          # non-POSIX: rename-only safety
-            yield
-            return
-        with open(lock_path, "w") as lf:
-            fcntl.flock(lf, fcntl.LOCK_EX)
-            try:
-                yield
-            finally:
-                fcntl.flock(lf, fcntl.LOCK_UN)
