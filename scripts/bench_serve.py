@@ -567,6 +567,172 @@ def run_prefix_bench(args, model, variables, concurrency) -> dict:
     return out
 
 
+def _spec_workload(concurrency, *, prompt_len, requests_per_client,
+                   vocab, seed=0):
+    """Per-client prompt plans for the speculative-decoding A/B —
+    built ONCE so the spec-on and spec-off engines serve the exact
+    same token streams (greedy: bitwise-identical output is pinned by
+    tests/test_serve_paged.py; the bench only measures speed)."""
+    plans = []
+    for i in range(concurrency):
+        crng = np.random.default_rng(seed + 2000 + i)
+        plans.append([
+            crng.integers(0, vocab, size=prompt_len).astype(np.int32)
+            for _ in range(requests_per_client)])
+    return plans
+
+
+def _run_spec_variant(engine, plans, *, new_tokens):
+    """Drive one engine through the plans (closed loop, one client per
+    plan) and report throughput plus the spec counters from the
+    engine's OWN registry — ``accepted_tokens_per_verify`` is the
+    number the speedup stands on."""
+    # The warm request must cover the same position range as the
+    # measured run: the burst/verify programs are compiled per
+    # attention-window bucket, so a short warm request would leave
+    # the deeper buckets to compile inside the measured window — a
+    # deployed replica deserializes the full closed set from the AOT
+    # store at boot instead.
+    warm = np.zeros(max(4, int(plans[0][0].size)), np.int32)
+    warm_new = 2
+    if getattr(engine, "spec_decode", False):
+        warm_new = new_tokens
+    engine.submit(warm, max_new_tokens=warm_new).result(timeout=600)
+    base = engine.registry.snapshot()
+    ttfts, e2es, errors = [], [], []
+    done_tokens = [0] * len(plans)
+
+    def client(i):
+        try:
+            for p in plans[i]:
+                req = engine.submit(p, max_new_tokens=new_tokens)
+                req.result(timeout=600)
+                ttfts.append(req.ttft_s)
+                e2es.append(req.e2e_s)
+                done_tokens[i] += len(req.tokens)
+        except Exception as e:  # noqa: BLE001 — report, don't hang
+            errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(plans))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    snap = engine.registry.snapshot()
+
+    def delta(name):
+        return snap.get(name, 0) - base.get(name, 0)
+
+    drafted = delta("serve_spec_draft_tokens_total")
+    accepted = delta("serve_spec_accepted_tokens_total")
+    verifies = delta("serve_spec_verify_steps_total")
+    total_tokens = sum(done_tokens)
+    slots = engine.slots
+    return {
+        "requests": sum(len(p) for p in plans),
+        "errors": errors,
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / wall, 1) if wall else 0.0,
+        "tokens_per_s_per_slot": round(total_tokens / wall / slots, 1)
+        if wall else 0.0,
+        "decode_steps": int(delta("serve_decode_steps_total")),
+        "draft_tokens": int(drafted),
+        "accepted_tokens": int(accepted),
+        "verify_steps": int(verifies),
+        "spec_acceptance_rate": round(accepted / drafted, 4)
+        if drafted else 0.0,
+        "accepted_tokens_per_verify": round(accepted / verifies, 2)
+        if verifies else 0.0,
+        "drafter_pool_bytes": engine.drafter_pool_bytes(),
+        "ttft_p50_ms": ms(ttfts, 50),
+        "ttft_p99_ms": ms(ttfts, 99),
+        "e2e_p99_ms": ms(e2es, 99),
+    }
+
+
+def run_spec_bench(args, model_cfg, model, variables,
+                   concurrency) -> dict:
+    """Speculative-decoding A/B: the identical workload through a
+    spec-off and a spec-on engine at the same pool geometry. The
+    drafter is FITTED to the bench workload first
+    (tpunet.serve.spec.fit_drafter distills a width-mult drafter onto
+    the serving model's own greedy trajectories) — the same flow an
+    operator uses against logged traffic, scaled down; an unfitted
+    drafter drafts noise and spec-on would honestly lose. The
+    acceptance claim is ``spec_on.tokens_per_s > spec_off
+    .tokens_per_s`` on the same streams (gated unconditionally by
+    check_serve_budget.py), with ``accepted_tokens_per_verify`` and
+    the drafter pool's extra bytes reported alongside."""
+    import jax
+
+    from tpunet.config import ServeConfig
+    from tpunet.serve import Engine
+    from tpunet.serve import spec as serve_spec
+
+    plans = _spec_workload(
+        concurrency, prompt_len=args.prompt_len,
+        requests_per_client=args.requests_per_client,
+        vocab=args.vocab_size)
+    drafter_cfg = serve_spec.drafter_model_config(
+        model_cfg, args.spec_width_mult)
+    from tpunet.models import create_model, init_variables
+    dmodel = create_model(drafter_cfg)
+    dparams = init_variables(dmodel, jax.random.PRNGKey(0),
+                             seq_len=16)["params"]
+    fit_prompts = np.stack([p for plan in plans for p in plan])
+    t_fit = time.perf_counter()
+    dparams = serve_spec.fit_drafter(
+        model, variables["params"], dmodel, dparams, fit_prompts,
+        gen_tokens=args.new_tokens, steps=args.spec_fit_steps,
+        log=lambda m: print(f"# {m}", file=sys.stderr, flush=True))
+    fit_s = time.perf_counter() - t_fit
+    bucket = 1 << max(4, (args.prompt_len - 1).bit_length())
+    bucket = min(bucket, args.max_seq_len)
+    variants = {}
+    for label, on in (("spec_off", False), ("spec_on", True)):
+        cfg = ServeConfig(slots=args.slots,
+                          queue_max=max(64, 4 * args.slots),
+                          prefill_buckets=(bucket,), emit_every_s=0.0,
+                          spec_decode=on, spec_k=args.spec_k,
+                          spec_draft_width_mult=args.spec_width_mult,
+                          **_lever_overrides(args))
+        engine = Engine(model, variables, cfg,
+                        drafter_params=dparams if on else None).start()
+        try:
+            variants[label] = _run_spec_variant(
+                engine, plans, new_tokens=args.new_tokens)
+        finally:
+            engine.stop()
+    on, off = variants["spec_on"], variants["spec_off"]
+    out = {
+        "mode": "spec",
+        "device": jax.devices()[0].device_kind,
+        "slots": args.slots,
+        "prompt_len": args.prompt_len,
+        "new_tokens": args.new_tokens,
+        "spec_k": args.spec_k,
+        "spec_width_mult": args.spec_width_mult,
+        "spec_fit_steps": args.spec_fit_steps,
+        "fit_wall_s": round(fit_s, 1),
+        "concurrency": concurrency,
+        "spec_on": on,
+        "spec_off": off,
+        # headline numbers mirrored at top level for dashboards
+        "tokens_per_s_per_slot": on["tokens_per_s_per_slot"],
+        "spec_acceptance_rate": on["spec_acceptance_rate"],
+        "accepted_tokens_per_verify": on["accepted_tokens_per_verify"],
+        "drafter_pool_bytes": on["drafter_pool_bytes"],
+    }
+    if off["tokens_per_s"]:
+        out["spec_speedup"] = round(
+            on["tokens_per_s"] / off["tokens_per_s"], 3)
+    return out
+
+
 def _get_json(url, timeout=10):
     import urllib.request
     with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -798,6 +964,25 @@ def main() -> None:
                     help="length of the shared prompt prefix (0 = "
                          "largest page multiple <= 3/4 of "
                          "--prompt-len)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding A/B: fit a drafter to "
+                         "the bench workload, then run the identical "
+                         "workload spec-on vs spec-off "
+                         "(check_serve_budget.py gates spec-on "
+                         "tokens/s above spec-off unconditionally)")
+    ap.add_argument("--spec-k", type=int, default=8,
+                    help="--spec: draft tokens per verify cycle "
+                         "(default 8: with --new-tokens 64 the budget "
+                         "divides as 1 + 7x9 so no request drops to "
+                         "the width-1 tail)")
+    ap.add_argument("--spec-width-mult", type=float, default=0.25,
+                    help="--spec: drafter width fraction (0.25: the "
+                         "drafter burst is K+1 SEQUENTIAL small "
+                         "steps, the one part of the cycle the wide "
+                         "verify cannot amortize — narrow pays)")
+    ap.add_argument("--spec-fit-steps", type=int, default=300,
+                    help="--spec: drafter distillation steps (fewer = "
+                         "faster bench, lower acceptance)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="LM best checkpoint (default: random tiny "
                          "weights — throughput shape, not quality)")
@@ -900,6 +1085,31 @@ def main() -> None:
                   "one of the flags", file=sys.stderr)
             sys.exit(2)
         out = run_prefix_bench(args, model, variables, max(levels))
+        print(json.dumps(out, indent=1))
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        if args.enforce_budget:
+            from check_serve_budget import check_record, load_budget
+            ok, msgs = check_record(out, load_budget())
+            for m in msgs:
+                print(f"# {m}", file=sys.stderr, flush=True)
+            if not ok:
+                sys.exit(3)
+        return
+
+    if args.spec:
+        if args.paged_kv is False or args.device_sampling is False:
+            # The engine would raise the same complaint at build time;
+            # exit 2 with the reason before any compile work starts.
+            print("--spec requires paged KV and device sampling "
+                  "(rejection is a page-table rewind; acceptance "
+                  "compares against the fused sampler); drop the "
+                  "--no-* flags", file=sys.stderr)
+            sys.exit(2)
+        out = run_spec_bench(args, model_cfg, model, variables,
+                             max(levels))
         print(json.dumps(out, indent=1))
         if args.out:
             os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)

@@ -241,6 +241,50 @@ def collect_serve_records() -> list:
     return sink.records
 
 
+def collect_spec_serve_records() -> list:
+    """obs_serve from a REAL speculative-decoding engine: the
+    serve_spec_* instruments only exist when the drafter path runs,
+    so a tiny spec engine (2 slots, K=2 self-speculation) decodes one
+    request end-to-end and its registry builds the record — a renamed
+    spec instrument fails here before it drifts from the doc."""
+    import jax
+    import numpy as np
+
+    from tpunet.config import ModelConfig, ServeConfig
+    from tpunet.models import create_model, init_variables
+    from tpunet.obs.registry import MemorySink, Registry
+    from tpunet.serve import Engine
+    from tpunet.serve.engine import build_serve_record
+
+    cfg = ModelConfig(name="lm", vit_hidden=16, vit_depth=1,
+                      vit_heads=2, dropout_rate=0.0, dtype="float32",
+                      vocab_size=17, max_seq_len=32)
+    model = create_model(cfg)
+    variables = init_variables(model, jax.random.PRNGKey(0),
+                               seq_len=8)
+    reg = Registry()
+    reg.set_identity(run_id="spec-check", process_index=0, host="h")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    eng = Engine(model, variables, ServeConfig(
+        slots=2, queue_max=4, prefill_buckets=(8,), emit_every_s=0.0,
+        spec_decode=True, spec_k=2, spec_draft_width_mult=1.0),
+        registry=reg).start()
+    try:
+        eng.submit(np.arange(4, dtype=np.int32),
+                   max_new_tokens=6).result(timeout=120)
+    finally:
+        eng.stop()
+    record = build_serve_record(
+        reg, queue_depth=0, active_slots=0, slots=2,
+        uptime_s=1.0, window_s=1.0, final=True)
+    assert record["spec_draft_tokens_total"] > 0
+    assert record["spec_verify_steps_total"] > 0
+    assert record["spec_acceptance_rate"] == 1.0  # self-speculation
+    reg.emit("obs_serve", record)
+    return sink.records
+
+
 def collect_regression_records() -> list:
     """obs_regression via the real path: two synthetic record streams
     summarized by the history store and compared (quantile rows with
@@ -584,6 +628,7 @@ def main() -> int:
     with tempfile.TemporaryDirectory() as tmp:
         records += collect_crash_records(tmp)
     records += collect_serve_records()
+    records += collect_spec_serve_records()
     records += collect_router_records()
     records += collect_trace_records()
     records += collect_slo_records()

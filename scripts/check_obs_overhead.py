@@ -13,6 +13,9 @@ under the subsystem's 0.5% measured overhead bar (two mmap writes per
 span, no syscalls on the step path). A serve-path variant drives one
 compiled engine with request tracing off vs on at the router's
 default head sampling (tpunet/obs/tracing.py) under the same bar.
+A speculative-decoding variant holds the serve_spec_* counter and
+gauge updates that ride every verify cycle to the same bar (null
+registry vs live registry on identical self-speculation engines).
 A prober-armed variant re-runs the paying burst with the SLO
 machinery live (tpunet/obs/slo.py): every completion feeds the
 default-policy ``SloEngine`` and a synthetic canary stream shares
@@ -140,6 +143,103 @@ def serve_trace_ratio() -> float:
     on = statistics.median(on_t)
     print(f"serve burst median: trace-off {off * 1e3:.1f}ms, "
           f"trace-default-sampling {on * 1e3:.1f}ms")
+    return on / off if off > 0 else float("inf")
+
+
+def serve_spec_obs_ratio() -> float:
+    """Spec-decode obs A/B: the serve_spec_* counters and the
+    acceptance-rate gauge ride EVERY verify cycle (engine.py
+    ``_spec_burst``), so the same compiled speculative engine
+    config is driven twice — once with a null registry that
+    swallows every instrument update, once with the real one —
+    and the paying burst must stay inside the same bar. The
+    drafter is self-speculation (``width_mult`` 1.0, zero fit
+    steps), which keeps the A/B about the obs path rather than
+    drafter quality: acceptance is 1.0 either way, so both arms
+    run an identical accept/emit schedule."""
+    import jax
+    import numpy as np
+
+    from tpunet.config import ModelConfig, ServeConfig
+    from tpunet.models import create_model, init_variables
+    from tpunet.obs.registry import Registry
+    from tpunet.serve import Engine
+
+    class _NullInstrument:
+        value = 0.0
+
+        def inc(self, n=1):
+            pass
+
+        def set(self, v):
+            pass
+
+        def observe(self, v):
+            pass
+
+        def summary(self):
+            return {}
+
+        def export_sample(self):
+            return []
+
+    class _NullRegistry(Registry):
+        _null = _NullInstrument()
+
+        def counter(self, name):
+            return self._null
+
+        def gauge(self, name):
+            return self._null
+
+        def histogram(self, name, **kw):
+            return self._null
+
+    model_cfg = ModelConfig(name="lm", vit_hidden=32, vit_depth=2,
+                            vit_heads=2, dropout_rate=0.0,
+                            dtype="float32", vocab_size=31,
+                            max_seq_len=48)
+    model = create_model(model_cfg)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 31, size=6).astype(np.int32)
+               for _ in range(SERVE_REQS)]
+
+    def make(reg) -> "Engine":
+        return Engine(model, variables,
+                      ServeConfig(slots=4, queue_max=2 * SERVE_REQS,
+                                  prefill_buckets=(8, 16),
+                                  default_max_new_tokens=6,
+                                  emit_every_s=0.0,
+                                  spec_decode=True, spec_k=3,
+                                  spec_draft_width_mult=1.0),
+                      registry=reg).start()
+
+    def burst(eng) -> None:
+        reqs = [eng.submit(p) for p in prompts]
+        for r in reqs:
+            r.result(timeout=120)
+
+    eng_null = make(_NullRegistry())
+    eng_real = make(Registry())
+    try:
+        burst(eng_null)       # compile warmup, one per arm
+        burst(eng_real)
+        off_t, on_t = [], []
+        for _ in range(SERVE_ROUNDS):   # interleaved: jitter is fair
+            t0 = time.perf_counter()
+            burst(eng_null)
+            off_t.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            burst(eng_real)
+            on_t.append(time.perf_counter() - t0)
+    finally:
+        eng_null.stop()
+        eng_real.stop()
+    off = statistics.median(off_t)
+    on = statistics.median(on_t)
+    print(f"spec burst median: counters-null {off * 1e3:.1f}ms, "
+          f"counters-live {on * 1e3:.1f}ms")
     return on / off if off > 0 else float("inf")
 
 
@@ -295,6 +395,13 @@ def main() -> int:
           f"(threshold {MAX_RATIO})")
     if trace_ratio > MAX_RATIO:
         print("FAIL: request tracing at default sampling exceeds the "
+              "overhead budget", file=sys.stderr)
+        fail = True
+    spec_ratio = serve_spec_obs_ratio()
+    print(f"serve-spec-counters-live-vs-null ratio {spec_ratio:.3f} "
+          f"(threshold {MAX_RATIO})")
+    if spec_ratio > MAX_RATIO:
+        print("FAIL: the speculative-decoding counters exceed the "
               "overhead budget", file=sys.stderr)
         fail = True
     probe_ratio = serve_probe_ratio()

@@ -31,7 +31,10 @@ Semantics mirror the bytes budget, with the direction flipped
 - Mode-dispatched: ``cold_start`` records gate the AOT boot latency
   ceiling (and aot < cold unconditionally); ``prefix`` records gate
   the shared-prefix TTFT p99 ceiling and require the cache-on run to
-  prefill fewer tokens per request than cache-off outright.
+  prefill fewer tokens per request than cache-off outright; ``spec``
+  records require the spec-on run to beat spec-off tokens/s outright
+  on the identical workload and gate spec-on tokens_per_s_per_slot
+  from below.
 """
 
 from __future__ import annotations
@@ -159,6 +162,49 @@ def check_prefix(record: Dict, key: str, entry: Dict,
     return ok and within, msgs
 
 
+def check_spec(record: Dict, key: str, entry: Dict,
+               tol: float) -> Tuple[bool, List[str]]:
+    """Gate a ``bench_serve.py --spec`` record: (a) the spec-on run
+    must move MORE tokens/s than spec-off outright, on the identical
+    workload — the whole point of drafting; a drafter that does not
+    pay for itself is a regression, not a tuning note — and (b)
+    spec-on ``tokens_per_s_per_slot`` stays above the checked-in
+    floor (a THROUGHPUT: gated from BELOW, floor * (1 - tolerance))."""
+    on = (record.get("spec_on") or {}).get("tokens_per_s")
+    off = (record.get("spec_off") or {}).get("tokens_per_s")
+    msgs: List[str] = []
+    ok = True
+    if on is None or off is None:
+        return True, [f"{key}: spec record has no spec-on/off "
+                      "throughput measurement; skipping"]
+    if on <= off:
+        ok = False
+        msgs.append(f"{key}: spec-on {on:.1f} tok/s, no better than "
+                    f"spec-off {off:.1f} [REGRESSION]")
+    else:
+        msgs.append(f"{key}: tokens_per_s {on:.1f} spec-on vs "
+                    f"{off:.1f} spec-off "
+                    f"({on / off:.2f}x) [OK]")
+    budgeted = entry.get("spec_tokens_per_s_per_slot")
+    measured = (record.get("spec_on") or {}).get("tokens_per_s_per_slot")
+    if budgeted is None:
+        msgs.append(f"{key}: no spec_tokens_per_s_per_slot floor; "
+                    "spec-on-beats-spec-off only")
+        return ok, msgs
+    if measured is None:
+        msgs.append(f"{key}: record carries no spec-on "
+                    f"tokens_per_s_per_slot (floor {budgeted:.1f}); "
+                    "skipping")
+        return ok, msgs
+    floor = budgeted * (1.0 - tol)
+    within = measured >= floor
+    msgs.append(
+        f"{key}: spec-on tokens_per_s_per_slot measured {measured:.1f}"
+        f" vs floor {budgeted:.1f} (-{100 * tol:.0f}% tolerance -> "
+        f"limit {floor:.1f}) [{'OK' if within else 'REGRESSION'}]")
+    return ok and within, msgs
+
+
 def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
     """-> (ok, messages). ok is False only on a real throughput drop;
     a missing budget entry or an unmeasurable record passes with a
@@ -173,6 +219,8 @@ def check_record(record: Dict, budget: Dict) -> Tuple[bool, List[str]]:
         return check_cold_start(record, key, entry, tol)
     if record.get("mode") == "prefix":
         return check_prefix(record, key, entry, tol)
+    if record.get("mode") == "spec":
+        return check_spec(record, key, entry, tol)
     ok_kv, kv_msgs = check_kv_bytes(record, key, entry, tol)
     budgeted = entry.get("tokens_per_s_per_slot")
     measured = tokens_per_s_per_slot(record)

@@ -7,8 +7,8 @@
 #
 # Usage:
 #   scripts/run_checks.sh            # fast: tpucheck, types, schema,
-#                                    # budgets (artifact-gated),
-#                                    # sanitizer smoke
+#                                    # budgets (artifact-gated), spec
+#                                    # bench A/B, sanitizer smoke
 #   scripts/run_checks.sh --slow     # + obs overhead, full asan/ubsan/
 #                                    # tsan stress matrix
 #
@@ -79,6 +79,13 @@ if ls SERVE_BENCH*.json >/dev/null 2>&1; then
 else
   skip_gate "serve-budget" "no SERVE_BENCH*.json artifact (run scripts/bench_serve.py --enforce-budget to gate in-process)"
 fi
+
+# Speculative-decoding A/B on the bench workload: fits the default
+# width_mult-0.25 drafter, serves the identical closed-loop traffic
+# spec-on vs spec-off, and gates in-process (check_serve_budget
+# check_spec: spec-on tokens/s strictly above spec-off
+# unconditionally, plus the per-slot spec floor).
+run_gate "spec-bench" python scripts/bench_serve.py --spec --enforce-budget
 
 # Router control plane against stdlib stub replicas (no devices, no
 # model): least-loaded routing, dead-replica re-route + evict,

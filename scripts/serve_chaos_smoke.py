@@ -41,7 +41,13 @@ engine mid-stream, resumed through the real bucketed-prefill path;
 spills cached pages to a shared ``--prefix-store``, the serving
 replica is SIGKILLed by pid, and its RESPAWN adopts the fleet's
 prefix set at boot — the first shared-prefix request on the fresh
-process prefills only the suffix.
+process prefills only the suffix; (c) speculative decoding under
+SIGKILL: a ``--spec-decode`` replica dies MID-VERIFY-WINDOW (the
+kill counter lands inside a burst's emit loop) and the survivor —
+also spec-on — resumes from the journal; because the engine only
+ever journals VERIFIED tokens, the stitched stream must be
+token-identical to an uninterrupted stream of the same request on
+the other replica.
 
 Wired into scripts/run_checks.sh (fast set; --slow adds --real).
 Exit 0 = all legs pass.
@@ -585,6 +591,75 @@ def leg_real_engine():
         server.drain()
 
 
+def leg_spec_kill_mid_verify():
+    """Slow leg (--real): SIGKILL of a SPECULATIVE-DECODING replica
+    mid-verify-window. kill@tokens=14 with K=3 self-speculation
+    (4 verified tokens per burst) fires inside the 4th window's emit
+    loop — the dying replica has streamed a partial verify window.
+    The survivor resumes spec-on from the journal; the stitched
+    stream must equal an UNINTERRUPTED run of the same request pinned
+    to the other replica, which is only true if every journaled token
+    was a verified one (a draft leaking into the stream would fork
+    the two runs at the seam)."""
+    import tempfile
+
+    from tpunet.router.__main__ import build_argparser, build_server
+    from tpunet.router.balance import preferred_replica
+    from tpunet.router.replica import ReplicaHandle
+
+    tmp = tempfile.mkdtemp(prefix="serve-chaos-spec-")
+    argv = ["--spawn", "2", "--port", "0",
+            "--probe-interval-s", "0.2", "--probe-timeout-s", "2",
+            "--unhealthy-after", "2", "--boot-timeout-s", "240",
+            "--respawn-backoff-s", "60",
+            "--emit-every-s", "0.5", "--min-replicas", "2",
+            "--max-replicas", "2", "--metrics-dir", tmp,
+            "--chaos", "kill@tokens=14:replica=0", "--",
+            "--checkpoint-dir", "", "--slots", "2",
+            "--prefill-buckets", "64", "--queue-max", "16",
+            "--max-new-tokens", "64", "--vit-hidden", "32",
+            "--vit-depth", "2", "--vit-heads", "2",
+            "--vocab-size", "256", "--max-seq-len", "256",
+            "--spec-decode", "--spec-k", "3",
+            "--spec-draft-width-mult", "1.0"]
+    server = build_server(build_argparser().parse_args(argv)).start()
+    router = server.router
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        wait_for(lambda: router.healthy_count() == 2, timeout=240,
+                 what="both spec replicas healthy (cold boot)")
+        fakes = [ReplicaHandle("r0", "http://x"),
+                 ReplicaHandle("r1", "http://x")]
+
+        def session_for(name):
+            return next(s for s in (f"s{i}" for i in range(64))
+                        if preferred_replica(fakes, f"s:{s}").name
+                        == name)
+
+        body = {"tokens": [7, 3, 9], "max_new_tokens": 24,
+                "stream": True}
+        # Uninterrupted reference on r1 FIRST (r0's chaos counter
+        # must not see these tokens).
+        ref = read_stream(base, dict(body,
+                                     session=session_for("r1")),
+                          timeout=240)
+        ref_toks = [ev["token"] for ev in ref if "token" in ev]
+        assert len(ref_toks) == 24, f"{len(ref_toks)} ref tokens"
+        lines = read_stream(base, dict(body,
+                                       session=session_for("r0")),
+                            timeout=240)
+        done = lines[-1]
+        toks = [ev["token"] for ev in lines if "token" in ev]
+        assert done.get("done") and done["finish_reason"] == "length", \
+            done
+        assert "error" not in done, done
+        assert done.get("failover_count", 0) >= 1, done
+        assert toks == ref_toks, \
+            "stitched spec stream != uninterrupted stream"
+    finally:
+        server.drain()
+
+
 def leg_prefix_warm_start():
     """Slow leg (--real): fleet-wide prefix warm start across a
     SIGKILL. Two real serve children share a ``--prefix-store``
@@ -700,6 +775,9 @@ def main() -> int:
         legs.append(("prefix warm start: SIGKILL -> respawn adopts "
                      "shared store, suffix-only prefill",
                      leg_prefix_warm_start))
+        legs.append(("spec decode: SIGKILL mid-verify -> survivor "
+                     "resumes verified-only journal",
+                     leg_spec_kill_mid_verify))
     failures = []
     for name, fn in legs:
         try:

@@ -675,3 +675,65 @@ def _wait(pred, timeout=20.0, what=""):
             return
         time.sleep(0.05)
     raise AssertionError(f"timed out: {what}")
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding x failover: verified-only journal resume
+# ---------------------------------------------------------------------------
+
+
+def test_spec_midverify_stop_resumes_from_verified_journal():
+    """A spec replica that stops mid-verify-window leaves a journal of
+    VERIFIED tokens only (the engine never push_token()s a draft), so
+    a survivor seeded with that journal continues the exact canonical
+    stream. Modeled in-process: replica A's budget cuts its last burst
+    in the middle of an accepted verify window (a full-accept
+    self-speculating drafter guarantees the window overshoots), then
+    replica B resumes with ``resume_tokens`` — greedy and sampled, the
+    stitched stream must be bitwise an uninterrupted spec-off run."""
+    import jax
+
+    from tpunet.config import ModelConfig
+    from tpunet.models import create_model, init_variables
+    from tpunet.serve import Engine
+
+    cfg = ModelConfig(name="lm", vit_hidden=32, vit_depth=2,
+                      vit_heads=2, dropout_rate=0.0, dtype="float32",
+                      vocab_size=31, max_seq_len=48)
+    model = create_model(cfg)
+    variables = init_variables(model, jax.random.PRNGKey(0), seq_len=8)
+
+    def make(spec):
+        return Engine(model, variables, ServeConfig(
+            slots=2, queue_max=8, prefill_buckets=(8, 16),
+            emit_every_s=0.0, spec_decode=spec, spec_k=3,
+            spec_draft_width_mult=1.0)).start()
+
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(0, 31, size=6).astype(np.int32)
+    # Sampling params are per-request, so ONE spec-off and ONE spec-on
+    # engine serve both the greedy and the sampled arm (compile once).
+    eng_off, eng_on = make(False), make(True)
+    try:
+        for samp in (dict(),
+                     dict(temperature=0.9, top_k=5, seed=77)):
+            canonical = eng_off.submit(
+                prompt, max_new_tokens=10, **samp).result(timeout=120)
+            # Replica A: K=3 self-spec emits 4 verified tokens per
+            # cycle; a budget of 6 stops it 2 tokens INTO the second
+            # verify window. Its stream is the journal.
+            journal = eng_on.submit(
+                prompt, max_new_tokens=6, **samp).result(timeout=120)
+            assert journal == canonical[:6], \
+                f"journal is not a verified-only prefix ({samp})"
+            # Replica B: resume from the journal, finish the budget
+            # (counter-based keys make the resumed rows land on the
+            # same (seed, step) stream the canonical run sampled).
+            resumed = eng_on.submit(
+                prompt, max_new_tokens=10, resume_tokens=journal,
+                **samp).result(timeout=120)
+            assert resumed == canonical, \
+                f"survivor diverged after mid-verify resume ({samp})"
+    finally:
+        eng_off.stop()
+        eng_on.stop()

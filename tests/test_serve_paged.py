@@ -688,3 +688,172 @@ def test_serializable_compile_restores_cache_flag():
         with serializable_compile():
             raise ValueError("boom")
     assert jax.config.jax_enable_compilation_cache == prev
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding over the paged pool
+# ---------------------------------------------------------------------------
+
+
+def _pool_clean(eng):
+    """Quiesce invariant: every usable page is either on the free list
+    or resident in the prefix cache — a rewind or release that dropped
+    a page shows up here immediately."""
+    cached = eng._prefix.pages_cached if eng._prefix else 0
+    return len(eng._free_pages) + cached == eng.kv_pages_usable
+
+
+def test_spec_config_requires_paged_and_device_sampling(tiny_lm):
+    """Drafting runs against the paged pool and samples on-device;
+    both fallbacks are config errors, not silent downgrades."""
+    for bad in (dict(paged_kv=False), dict(device_sampling=False),
+                dict(spec_k=0), dict(spec_draft_width_mult=0.0)):
+        with pytest.raises(ValueError):
+            make_engine(tiny_lm, spec_decode=True, **bad)
+
+
+def test_spec_greedy_bitwise_identical_both_acceptance_extremes(tiny_lm):
+    """Greedy spec-on output must be BITWISE spec-off at both ends of
+    the acceptance spectrum: a width_mult-1.0 drafter (the serving
+    model drafting for itself — every draft accepted) and a random-
+    init half-width drafter (near-total rejection — every cycle falls
+    back to the one verified token). Every emitted token comes from
+    the verify program, so acceptance can only change SPEED."""
+    ps = prompts(5, rng_seed=7)
+    solo = [solo_greedy(tiny_lm, p, 10) for p in ps]
+    for wm, expect_all_accepted in ((1.0, True), (0.5, False)):
+        eng = make_engine(tiny_lm, spec_decode=True, spec_k=3,
+                          spec_draft_width_mult=wm).start()
+        try:
+            reqs = [eng.submit(p, max_new_tokens=10) for p in ps]
+            outs = [r.result(timeout=120) for r in reqs]
+        finally:
+            eng.stop()
+        assert outs == solo, f"wm={wm} diverged from solo greedy"
+        snap = eng.registry.snapshot()
+        drafted = snap["serve_spec_draft_tokens_total"]
+        acc = snap["serve_spec_accepted_tokens_total"]
+        rej = snap["serve_spec_rejected_tokens_total"]
+        assert drafted > 0 and snap["serve_spec_verify_steps_total"] > 0
+        assert acc + rej == drafted
+        if expect_all_accepted:
+            assert acc == drafted, "self-speculation must accept all"
+        else:
+            assert rej > 0, "random drafter should see rejections"
+        assert _pool_clean(eng), "rewind/release leaked a page"
+
+
+def test_spec_sampled_stream_identical_and_preempt_deterministic(tiny_lm):
+    """Sampled requests: spec-on draws each position with the same
+    (seed, step) counter key the sequential loop would have used, so
+    the stream is bitwise spec-off — including across a pool-pressure
+    preemption, where the resumed slot continues its exact sample
+    sequence (steps0 = len(req.tokens) re-derives the key)."""
+    kw = dict(max_new_tokens=8, temperature=0.9, top_k=5, seed=123)
+    ps = prompts(4, rng_seed=11, lo=6, hi=7)
+
+    def run(**cfg_kw):
+        eng = make_engine(tiny_lm, **cfg_kw).start()
+        try:
+            reqs = [eng.submit(p, **kw) for p in ps]
+            return eng, [r.result(timeout=120) for r in reqs]
+        finally:
+            eng.stop()
+
+    _, base = run()
+    eng_on, sampled = run(spec_decode=True, spec_k=3,
+                          spec_draft_width_mult=0.5)
+    assert sampled == base, "spec-on sampled stream diverged"
+    assert _pool_clean(eng_on)
+    # Tight pool: two co-residents cannot both finish without a
+    # preemption; the preempted request must still produce the same
+    # sampled stream after resume-prefill.
+    eng_tight, tight = run(spec_decode=True, spec_k=3,
+                           spec_draft_width_mult=0.5, slots=2,
+                           kv_pages=5, kv_page_tokens=4)
+    assert tight == base, "preempt-resume broke sample determinism"
+    assert eng_tight.registry.snapshot()[
+        "serve_kv_preemptions_total"] >= 1, \
+        "pool never preempted; the resume path was not exercised"
+    assert _pool_clean(eng_tight)
+
+
+def test_spec_rejection_rewind_recycles_pages(tiny_lm):
+    """The leak test for cursor rewind: a random half-width drafter
+    rejects nearly everything, so every burst allocates pages through
+    pos+K and rewinds most of them. Churn waves over a small pool
+    until every page has been reused; greedy parity proves recycled
+    pages carry no stale K/V from a rewound burst, and at quiesce
+    free + prefix-cached must equal the whole pool."""
+    eng = make_engine(tiny_lm, slots=2, kv_pages=8, kv_page_tokens=4,
+                      prefix_cache=False, spec_decode=True, spec_k=3,
+                      spec_draft_width_mult=0.5).start()
+    try:
+        for wave in range(3):
+            ps = prompts(4, rng_seed=300 + wave, lo=5, hi=9)
+            reqs = [eng.submit(p, max_new_tokens=8) for p in ps]
+            for p, r in zip(ps, reqs):
+                assert r.result(timeout=120) == \
+                    solo_greedy(tiny_lm, p, 8), f"wave {wave} diverged"
+        snap = eng.registry.snapshot()
+        assert snap["serve_spec_rejected_tokens_total"] > 0
+        assert snap["serve_kv_page_allocs_total"] > eng.kv_pages_usable
+        assert len(eng._free_pages) == eng.kv_pages_usable, \
+            "a rewound or released page leaked"
+        assert snap["serve_kv_pages_used"] == 0
+    finally:
+        eng.stop()
+
+
+def test_spec_rewind_clamps_at_pinned_prefix_pages(tiny_lm):
+    """A rejection rewind must never free or zero a page the slot
+    pinned from the prefix cache: with a shared page-aligned prompt
+    and a heavily-rejecting drafter, later requests keep hitting the
+    SAME cached pages and must stay solo-greedy-identical — a rewind
+    that clawed back (or a burst that overwrote) a shared page would
+    corrupt every later hit."""
+    eng = make_engine(tiny_lm, slots=2, kv_pages=16, kv_page_tokens=4,
+                      spec_decode=True, spec_k=3,
+                      spec_draft_width_mult=0.5).start()
+    try:
+        rng = np.random.default_rng(23)
+        p = rng.integers(0, TINY.vocab_size, size=8).astype(np.int32)
+        outs = [eng.submit(p, max_new_tokens=6).result(timeout=120)
+                for _ in range(3)]
+        snap = eng.registry.snapshot()
+        assert snap["serve_prefix_hits_total"] >= 2
+        assert snap["serve_spec_rejected_tokens_total"] > 0
+        assert _pool_clean(eng)
+    finally:
+        eng.stop()
+    want = solo_greedy(tiny_lm, p, 6)
+    assert outs == [want] * 3, \
+        "a spec rewind or draft write disturbed shared prefix pages"
+
+
+def test_spec_serve_record_and_instruments(tiny_lm):
+    """The ops contract: a spec engine's serve record carries the
+    spec_* fields (docs/metrics_schema.md obs_serve) with coherent
+    derived rates, and the serve_spec_* instruments exist on the
+    registry."""
+    from tpunet.serve.engine import build_serve_record
+
+    eng = make_engine(tiny_lm, spec_decode=True, spec_k=3,
+                      spec_draft_width_mult=1.0).start()
+    try:
+        eng.submit(prompts(1, rng_seed=3)[0],
+                   max_new_tokens=8).result(timeout=120)
+    finally:
+        eng.stop()
+    rec = build_serve_record(eng.registry, queue_depth=0,
+                             active_slots=0, slots=4, uptime_s=1.0,
+                             window_s=1.0)
+    assert rec["spec_draft_tokens_total"] > 0
+    assert rec["spec_accepted_tokens_total"] \
+        + rec["spec_rejected_tokens_total"] \
+        == rec["spec_draft_tokens_total"]
+    assert rec["spec_verify_steps_total"] > 0
+    assert rec["spec_acceptance_rate"] == 1.0   # self-speculation
+    assert rec["spec_accepted_tokens_per_verify"] > 0
+    assert eng.registry.snapshot()[
+        "serve_spec_acceptance_rate"] == 1.0

@@ -508,6 +508,36 @@ class ServeConfig:
     # a router the router decides (its headers win); a client-supplied
     # ``X-Trace-Id`` is always sampled. 0 = only header-carried traces.
     trace_sample: float = 0.0
+    # Speculative decoding (--spec-decode, docs/serving.md
+    # "Speculative decoding"): a small drafter model proposes spec_k
+    # tokens per active slot against its OWN paged KV pool, then the
+    # main model verifies every slot's drafts in ONE [slots, K+1]-wide
+    # jitted forward over the existing pool — up to K+1 verified
+    # tokens per slot per verify. Every emitted token comes from the
+    # VERIFY distribution, so greedy output is bitwise-identical to
+    # spec-off and sampled output stays deterministic per (seed, step)
+    # (failover/replay safe). Rejection rewinds the slot's page-table
+    # cursor to the last accepted position and recycles the tail
+    # pages. Requires paged_kv AND device_sampling.
+    spec_decode: bool = False
+    # Draft tokens proposed per verify cycle (the K in draft-then-
+    # verify). Higher K amortizes the verify gather over more tokens
+    # but wastes drafter work when acceptance is low — docs/serving.md
+    # "Speculative decoding" has the tuning math.
+    spec_k: int = 4
+    # Drafter width multiplier on the serving model's vit_hidden
+    # (rounded to stay divisible by vit_heads). 1.0 shares the main
+    # model's parameters (self-speculation — useful for parity tests,
+    # never a throughput win); < 1.0 builds a second, narrower model
+    # instance whose parameters come from --spec-draft-checkpoint or
+    # a deterministic init.
+    spec_draft_width_mult: float = 0.5
+    # Drafter parameters (.npz from tpunet/serve/spec.py
+    # ``save_drafter_params``; empty = deterministic random init,
+    # which accepts ~nothing — fit or distill a drafter against real
+    # traffic, e.g. ``spec.fit_drafter`` as bench_serve.py --spec
+    # does).
+    spec_draft_checkpoint: str = ""
 
 
 @dataclass(frozen=True)

@@ -123,6 +123,33 @@ def build_argparser():
                         "replica adopts the fleet's prefix set at "
                         "boot; entries scoped by model config + kv "
                         "levers so a lever change is a clean miss")
+    p.add_argument("--spec-decode", default=d.spec_decode,
+                   action=argparse.BooleanOptionalAction,
+                   help="speculative decoding (default off, needs "
+                        "paged KV + device sampling): a narrow "
+                        "drafter proposes --spec-k tokens per slot "
+                        "against its own paged pool, ONE wide verify "
+                        "over the main pool scores them, rejection "
+                        "rewinds the page-table cursor — output is "
+                        "bitwise-identical to spec-off at any "
+                        "acceptance rate (docs/serving.md)")
+    p.add_argument("--spec-k", type=int, default=d.spec_k,
+                   help="draft tokens per verify cycle (a slot emits "
+                        "1..K+1 verified tokens per cycle)")
+    p.add_argument("--spec-draft-width-mult", type=float,
+                   default=d.spec_draft_width_mult,
+                   help="drafter width as a fraction of the serving "
+                        "model's hidden dim (floored to a multiple "
+                        "of the head count; 1.0 = self-speculation "
+                        "for parity testing)")
+    p.add_argument("--spec-draft-checkpoint", default=d.
+                   spec_draft_checkpoint, metavar="NPZ",
+                   help="fitted drafter weights (tpunet.serve.spec."
+                        "save_drafter_params npz); empty = "
+                        "deterministic random init, which is correct "
+                        "but drafts nothing useful — fit one against "
+                        "real traffic with tpunet.serve.spec."
+                        "fit_drafter")
     p.add_argument("--device-sampling", default=d.device_sampling,
                    action=argparse.BooleanOptionalAction,
                    help="batched temperature/top-k/top-p sampling "
@@ -262,7 +289,10 @@ def build_server(args):
         emit_every_s=args.emit_every_s,
         drain_timeout_s=args.drain_timeout_s,
         run_id=args.run_id, aot_cache=args.aot_cache,
-        chaos=args.chaos, trace_sample=args.trace_sample)
+        chaos=args.chaos, trace_sample=args.trace_sample,
+        spec_decode=args.spec_decode, spec_k=args.spec_k,
+        spec_draft_width_mult=args.spec_draft_width_mult,
+        spec_draft_checkpoint=args.spec_draft_checkpoint)
     model_cfg = ModelConfig(
         name=args.model, vit_hidden=args.vit_hidden,
         vit_depth=args.vit_depth, vit_heads=args.vit_heads,

@@ -191,6 +191,26 @@ def build_serve_record(reg, *, queue_depth: int, active_slots: int,
     record["prefix_hit_rate"] = (
         round(record["prefix_hits_total"] / lookups, 4) if lookups
         else 0.0)
+    # Speculative decoding (serve_spec_* instruments; zeros with spec
+    # off): acceptance rate is THE drafter-quality signal — a drafter
+    # that stops matching its serving model shows up here before it
+    # shows up as a tokens/s regression.
+    for cname, field in (
+            ("serve_spec_draft_tokens_total", "spec_draft_tokens_total"),
+            ("serve_spec_accepted_tokens_total",
+             "spec_accepted_tokens_total"),
+            ("serve_spec_rejected_tokens_total",
+             "spec_rejected_tokens_total"),
+            ("serve_spec_verify_steps_total", "spec_verify_steps_total")):
+        record[field] = int(reg.counter(cname).value)
+    drafted = record["spec_draft_tokens_total"]
+    record["spec_acceptance_rate"] = (
+        round(record["spec_accepted_tokens_total"] / drafted, 4)
+        if drafted else 0.0)
+    verifies = record["spec_verify_steps_total"]
+    record["spec_accepted_tokens_per_verify"] = (
+        round(record["spec_accepted_tokens_total"] / verifies, 4)
+        if verifies else 0.0)
     if final:
         record["final"] = True
     return record
@@ -219,6 +239,13 @@ def build_aot_store(directory: str, model_cfg, serve_cfg):
         "kv_page_tokens": serve_cfg.kv_page_tokens,
         "kv_dtype": serve_cfg.kv_dtype,
         "device_sampling": serve_cfg.device_sampling,
+        # Spec-decode levers select a different program SET (drafter
+        # width changes the drafter executables, K changes the verify
+        # width): spec-on and spec-off engines must never share blobs.
+        "spec_decode": getattr(serve_cfg, "spec_decode", False),
+        "spec_k": getattr(serve_cfg, "spec_k", 4),
+        "spec_draft_width_mult": getattr(
+            serve_cfg, "spec_draft_width_mult", 0.5),
     })
     return AotProgramStore(directory, digest)
 
@@ -253,7 +280,8 @@ class Engine:
     """
 
     def __init__(self, model, variables, cfg, *, registry=None,
-                 mesh=None, aot_store=None, prefix_store=None):
+                 mesh=None, aot_store=None, prefix_store=None,
+                 drafter_params=None):
         import jax
         import jax.numpy as jnp
 
@@ -328,6 +356,86 @@ class Engine:
                 self._prefix = PrefixCache(self.page_tokens, cap,
                                            registry=self.registry)
                 self._prefix_store = prefix_store
+        # -- speculative decoding (tpunet/serve/spec.py) ---------------
+        # A narrow drafter proposes spec_k tokens per active slot
+        # against its OWN paged pool, then ONE [slots, K+1]-wide
+        # verify over the main pool scores them — up to K+1 verified
+        # tokens per slot per cycle. The drafter pool shares THIS
+        # page table (identical geometry: same page ids, same
+        # page_tokens), so allocate-on-advance, cursor rewind,
+        # release, and preemption keep both pools in lockstep with
+        # zero extra allocator state. Every emitted token comes from
+        # the verify program, so the stream is bitwise identical to
+        # spec-off at any acceptance rate.
+        self.spec_decode = bool(getattr(cfg, "spec_decode", False))
+        self.spec_k = int(getattr(cfg, "spec_k", 4))
+        self._drafter_model = None
+        self._drafter_params = None
+        self._draft_cache = None
+        self._drafter_paged_kv = None
+        if self.spec_decode:
+            if self._paged_kv is None:
+                raise ValueError(
+                    "spec_decode requires the paged KV cache (drop "
+                    "--no-paged-kv): rejection is a page-table cursor "
+                    "rewind")
+            if not self.device_sampling:
+                raise ValueError(
+                    "spec_decode requires device sampling (drop "
+                    "--no-device-sampling): acceptance compares the "
+                    "drafter against the fused sampler's per-"
+                    "(seed, step) choices")
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"spec_k must be >= 1, got {cfg.spec_k}")
+            wm = float(getattr(cfg, "spec_draft_width_mult", 0.5))
+            if wm <= 0:
+                raise ValueError(
+                    "spec_draft_width_mult must be > 0, got "
+                    f"{wm}")
+            if wm == 1.0:
+                # Self-speculation: the drafter IS the serving model
+                # (still with its own pool — it runs ahead of the
+                # verified cursor). 100% acceptance by construction;
+                # useful for parity tests, never a throughput win.
+                self._drafter_model = model
+                self._drafter_params = variables["params"]
+            else:
+                if not hasattr(model, "hidden") \
+                        or not hasattr(model, "heads"):
+                    raise ValueError(
+                        "spec_draft_width_mult != 1.0 needs a model "
+                        "with width levers (TransformerLM); got "
+                        f"{type(model).__name__}")
+                heads = int(model.heads)
+                dh = max(heads, int(int(model.hidden) * wm)
+                         // heads * heads)
+                self._drafter_model = model.clone(hidden=dh)
+                self._drafter_params = None   # resolved below
+            from tpunet.models.vit import PagedKV
+            self._drafter_paged_kv = PagedKV(
+                pages=self.kv_pages_usable + 1,
+                page_tokens=self.page_tokens, dtype=cfg.kv_dtype)
+            if drafter_params is not None:
+                # In-memory drafter weights (bench_serve --spec fits
+                # the drafter to its workload and injects it here).
+                self._drafter_params = drafter_params
+            elif self._drafter_params is None:
+                import jax as _jax
+                from tpunet.models import init_variables
+                template = init_variables(
+                    self._drafter_model, _jax.random.PRNGKey(0),
+                    seq_len=min(16, self.max_seq_len))["params"]
+                ckpt = getattr(cfg, "spec_draft_checkpoint", "")
+                if ckpt:
+                    from tpunet.serve import spec as serve_spec
+                    self._drafter_params = \
+                        serve_spec.load_drafter_params(ckpt, template)
+                else:
+                    # Deterministic random init: correct (acceptance
+                    # just tends to zero) but pointless for
+                    # throughput — fit a drafter for real traffic.
+                    self._drafter_params = template
         self._page_ops = None        # (read, write, copy) jitted lazily
         self._admit_seq = 0
         self.peak_active_slots = 0   # high-water mark (bench_serve
@@ -386,6 +494,11 @@ class Engine:
         self._cache = self._make_cache()
         self._inactive_tok = np.zeros((self.slots, 1), np.int32)
         self._zero_idx = np.zeros((self.slots,), np.int32)
+        if self._drafter_model is not None:
+            self._draft_cache = self._make_cache(
+                model=self._drafter_model,
+                paged_kv=self._drafter_paged_kv)
+            self._build_spec_programs()
         self._init_kv_gauges()
         # AOT warm-start (tpunet/utils/cache.py AotProgramStore): the
         # engine's program set is closed — [N, 1] decode + one [N, Lb]
@@ -446,6 +559,53 @@ class Engine:
             else:
                 self.aot_status[tag] = "loaded"
             self._aot[width] = program
+        if self._drafter_model is None:
+            return
+        # Spec programs are part of the replica's closed program set
+        # too: drafter prefill per bucket, the K+1 draft burst, and
+        # the [slots, K+1] verify — a spec-on replica cold-starts
+        # without tracing just like a spec-off one. The store digest
+        # folds the spec levers, so spec-on/off never share blobs.
+        dparams_s = sds(self._drafter_params)
+        dcache_s = sds(self._draft_cache)
+        samp_s = (f32(self.slots), i32(self.slots), f32(self.slots),
+                  i32(self.slots), i32(self.slots))
+        k = self.spec_k
+        programs = []
+        # Burst/verify are compiled per attention-window bucket (the
+        # engine slices the page table to the live window at call
+        # time); the full closed set is log2(pages_per_slot) pairs.
+        for win in self._spec_window_buckets:
+            win_s = i32(self.slots, win)
+            programs.append(
+                ("spec_draft_burst", f"k{k}w{win}",
+                 self._draft_burst_fn,
+                 (dparams_s, dcache_s, i32(self.slots), pos_s, act_s,
+                  win_s) + samp_s))
+            programs.append(
+                ("spec_verify", f"k{k}w{win}", self._verify_fn,
+                 (params_s, cache_s, i32(self.slots, k + 1), pos_s,
+                  act_s, win_s) + samp_s))
+        for width in self.buckets:
+            win = self._spec_window(
+                (width - 1) // self.page_tokens + 1)
+            programs.append(
+                ("spec_draft_prefill", f"w{width}",
+                 self._draft_prefill_fn,
+                 (dparams_s, dcache_s, i32(self.slots, width), pos_s,
+                  act_s, i32(self.slots, win))))
+        from tpunet.utils.cache import serializable_compile
+        for name, tag, fn, shapes in programs:
+            program = store.load(name, tag)
+            if program is None:
+                with serializable_compile():
+                    program = fn.lower(*shapes).compile()
+                saved = store.save(name, tag, program)
+                self.aot_status[f"{name}-{tag}"] = (
+                    "compiled+saved" if saved else "compiled")
+            else:
+                self.aot_status[f"{name}-{tag}"] = "loaded"
+            self._spec_aot[(name, tag)] = program
 
     def _dispatch_step(self, toks, positions, active, last_idx=None):
         """Run one masked-step program: the AOT executable for this
@@ -488,19 +648,146 @@ class Engine:
         return [np.asarray(last_idx, np.int32), temp, top_k, top_p,
                 seeds, steps]
 
-    # -- pool construction ---------------------------------------------
+    # -- speculative-decoding programs (docs/serving.md) ----------------
 
-    def _make_cache(self):
+    def _build_spec_programs(self) -> None:
+        """Three jitted spec programs, all [slots]-wide and masked
+        like the main step (one compile each, AOT-serializable):
+
+        - drafter prefill: write-only full-prompt pass filling the
+          drafter pool (per prefill bucket).
+        - draft burst: K+1 fused drafter steps. Iteration j consumes
+          token t_j at position pos+j, writes drafter K/V there, and
+          samples d_{j+1} with the SAME (seed, step=s0+j) key the
+          verifier will use — lockstep keys are what make a perfect
+          drafter accept at temperature > 0 too. The K+1'th draft is
+          discarded, but its K/V write keeps the drafter pool gapless
+          after a full acceptance (both cursors then cover pos+K).
+        - verify: ONE [slots, K+1] forward over the main pool scoring
+          [next_token, d_1..d_K] at positions pos..pos+K, sampling
+          choice c_j per position with step s0+j.
+        """
         import jax
         import jax.numpy as jnp
+
+        from tpunet.serve.sampling import (batched_sample,
+                                           batched_sample_positions)
+
+        dmodel = self._drafter_model
+        dpaged = self._drafter_paged_kv
+        model = self.model
+        paged = self._paged_kv
+        k = self.spec_k
+
+        def _draft_prefill(params, cache, tokens, positions, active,
+                           page_table):
+            _, mutated = dmodel.apply(
+                {"params": params, "cache": cache}, tokens,
+                train=False, decode=True, pos_offset=positions,
+                decode_active=active, paged_kv=dpaged,
+                page_table=page_table, mutable=["cache"])
+            return mutated["cache"]
+
+        def _draft_burst(params, cache, first_tok, positions, active,
+                         page_table, temp, top_k, top_p, seeds,
+                         steps0):
+            def body(carry, j):
+                cache, tok = carry
+                logits, mutated = dmodel.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    train=False, decode=True, pos_offset=positions + j,
+                    decode_active=active, paged_kv=dpaged,
+                    page_table=page_table, mutable=["cache"])
+                nxt = batched_sample(
+                    logits[:, 0].astype(jnp.float32), temp, top_k,
+                    top_p, seeds, steps0 + j)
+                return (mutated["cache"], nxt), nxt
+            (cache, _), drafts = jax.lax.scan(
+                body, (cache, first_tok),
+                jnp.arange(k + 1, dtype=jnp.int32))
+            # drafts is [K+1, B] = d_1..d_{K+1}; d_{K+1} lies beyond
+            # the verify window and is dropped.
+            return cache, drafts[:k].T
+
+        def _verify(params, cache, tokens, positions, active,
+                    page_table, temp, top_k, top_p, seeds, steps0):
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, tokens,
+                train=False, decode=True, pos_offset=positions,
+                decode_active=active, paged_kv=paged,
+                page_table=page_table, mutable=["cache"])
+            choices = batched_sample_positions(
+                logits.astype(jnp.float32), temp, top_k, top_p,
+                seeds, steps0)
+            return mutated["cache"], choices
+
+        self._draft_prefill_fn = jax.jit(_draft_prefill,
+                                         donate_argnums=(1,))
+        self._draft_burst_fn = jax.jit(_draft_burst,
+                                       donate_argnums=(1,))
+        self._verify_fn = jax.jit(_verify, donate_argnums=(1,))
+        self._spec_aot: dict = {}
+        # Attention-window buckets for the spec programs, in PAGE
+        # SLOTS (columns of the page table). The paged attend derives
+        # its whole key window from ``page_table.shape[1]`` — gather
+        # size, score matrix, mask — so slicing the table to the
+        # smallest bucket covering every burst slot's pos+K shrinks
+        # the verify/burst attention from O(max_seq_len) keys to
+        # O(live sequence) with NO model change, and the extra
+        # (masked, exp->0) columns it drops contribute exactly zero,
+        # so outputs stay bitwise identical across buckets. Doubling
+        # buckets bound the compile count at log2(pages_per_slot).
+        buckets, w = [], 4
+        while w < self.pages_per_slot:
+            buckets.append(w)
+            w *= 2
+        buckets.append(self.pages_per_slot)
+        self._spec_window_buckets = tuple(buckets)
+
+    def _spec_window(self, need_slots: int) -> int:
+        """Smallest window bucket covering ``need_slots`` page-table
+        columns (attention window for a spec program call)."""
+        for w in self._spec_window_buckets:
+            if w >= need_slots:
+                return w
+        return self._spec_window_buckets[-1]
+
+    def _dispatch_spec(self, name: str, tag: str, fallback, args):
+        """Run one spec program: the AOT executable when warm-started,
+        the jit fallback otherwise (mirrors ``_dispatch_step``)."""
+        program = self._spec_aot.get((name, tag))
+        if program is None:
+            program = fallback
+        return program(*args)
+
+    def drafter_pool_bytes(self) -> int:
+        """Resident bytes of the drafter's KV pool (0 with spec off) —
+        reported separately from ``kv_pool_bytes`` because the drafter
+        pool is the spec lever's EXTRA memory cost (width 0.5 ≈ +50%
+        KV bytes), and the bench must account for it honestly."""
+        import jax
+        if self._draft_cache is None:
+            return 0
+        return int(sum(leaf.nbytes
+                       for leaf in jax.tree_util.tree_leaves(
+                           self._draft_cache)))
+
+    # -- pool construction ---------------------------------------------
+
+    def _make_cache(self, model=None, paged_kv=None):
+        import jax
+        import jax.numpy as jnp
+        model = model if model is not None else self.model
+        if paged_kv is None:
+            paged_kv = self._paged_kv
         init_kw = {}
-        if self._paged_kv is not None:
+        if paged_kv is not None:
             init_kw = dict(
-                paged_kv=self._paged_kv,
+                paged_kv=paged_kv,
                 page_table=jnp.zeros((self.slots, self.pages_per_slot),
                                      jnp.int32))
         shapes = jax.eval_shape(
-            lambda: self.model.init(
+            lambda: model.init(
                 jax.random.PRNGKey(0),
                 jnp.zeros((self.slots, self.max_seq_len), jnp.int32),
                 decode=True, **init_kw))
@@ -577,14 +864,17 @@ class Engine:
         self.registry.counter("serve_kv_page_allocs_total").inc(need)
         return pages
 
-    def _ensure_page_capacity(self, slot_i: int, slot: _Slot) -> bool:
+    def _ensure_page_capacity(self, slot_i: int, slot: _Slot,
+                              through_pos: int = -1) -> bool:
         """Allocate-on-advance: make sure the page covering the slot's
         next write position exists (pinned prefix pages count toward
         coverage; new pages are always PRIVATE — decode never writes a
-        shared page). False = pool exhausted even after evicting every
-        evictable prefix page (the slot sits this iteration out, or
-        gets preempted)."""
-        need = slot.pos // self.page_tokens + 1
+        shared page). ``through_pos`` extends coverage to a LATER
+        position (a spec burst writes pos..pos+K in one cycle; the
+        over-allocation is what the rejection rewind recycles). False
+        = pool exhausted even after evicting every evictable prefix
+        page (the slot sits this iteration out, or gets preempted)."""
+        need = max(slot.pos, through_pos) // self.page_tokens + 1
         while len(slot.pinned) + len(slot.pages) < need:
             if not self._free_pages and not self._evict_prefix_page():
                 return False
@@ -1261,6 +1551,20 @@ class Engine:
                 (slot_i, req, resume, pages, start, pinned))
         for bucket, group in sorted(by_bucket.items()):
             self._prefill(bucket, group)
+        if self._drafter_model is not None:
+            # Drafter pool warm-up rides the same admission beat. The
+            # drafter re-embeds the FULL prompt (prefix hits included)
+            # so the grouping key is the full-length bucket, not the
+            # suffix bucket the main prefill used.
+            draft_groups: dict = {}
+            for slot_i, _, _, resume, _, _, _ in admitted:
+                if self._active[slot_i] is None:
+                    continue     # finished inside its own prefill
+                draft_groups.setdefault(
+                    self.bucket_for(int(resume.size)), []).append(
+                        (slot_i, resume))
+            for bucket, rows in sorted(draft_groups.items()):
+                self._draft_prefill(bucket, rows)
         self._update_kv_gauges()
         now_active = self.active_slots()
         self.peak_active_slots = max(self.peak_active_slots, now_active)
@@ -1384,6 +1688,39 @@ class Engine:
         reg.histogram("serve_prefill_s").observe(
             time.perf_counter() - t0)
 
+    def _draft_prefill(self, bucket: int, rows) -> None:
+        """Prefill the DRAFTER's paged pool for freshly admitted
+        slots: one write-only full-prompt pass per bucket. ``rows``
+        are ``(slot_i, resume_tokens)``.
+
+        The drafter always embeds the FULL prompt from position 0,
+        even when the main prefill rode a prefix-cache hit. Pinned
+        prefix page ids are shared across slots and the drafter pool
+        mirrors the main page table verbatim, so a drafter write to a
+        shared page id is an IDEMPOTENT rewrite: every slot pinning
+        that page holds the same token prefix and the drafter is
+        deterministic, hence bit-identical K/V. Re-deriving instead
+        of caching drafter pages keeps the drafter pool warm with
+        ZERO extra allocator state and no drafter-side COW (the
+        divergence page's drafter rows are simply written here). The
+        cost is one drafter-width full prefill per admission — part
+        of the lever's price, measured by ``bench_serve --spec``."""
+        toks = np.zeros((self.slots, bucket), np.int32)
+        active = np.zeros((self.slots,), bool)
+        positions = np.zeros((self.slots,), np.int32)
+        for slot_i, resume in rows:
+            toks[slot_i, :int(resume.size)] = resume
+            active[slot_i] = True
+        # Prompt positions span 0..bucket-1, so the attention window
+        # is static per bucket — the tag stays ``w{bucket}``.
+        win = self._spec_window((bucket - 1) // self.page_tokens + 1)
+        with _ring_span("tpunet/serve_spec_prefill"):
+            self._draft_cache = self._dispatch_spec(
+                "spec_draft_prefill", f"w{bucket}",
+                self._draft_prefill_fn,
+                (self._drafter_params, self._draft_cache, toks,
+                 positions, active, self._page_table[:, :win]))
+
     def _slot_maybe_finish(self, slot_i: int, token: int) -> bool:
         """Stop checks after a sampled token; True when the slot was
         freed."""
@@ -1406,6 +1743,8 @@ class Engine:
         a slot the pool cannot extend sits the iteration out, and when
         NOTHING can advance the youngest blocked slot is preempted back
         to the queue so the others drain and free pages."""
+        if self._drafter_model is not None:
+            return self._spec_decode_iteration()
         live = [(i, s) for i, s in enumerate(self._active)
                 if s is not None]
         if not live:
@@ -1425,6 +1764,13 @@ class Engine:
             live = ready
             if not live:
                 return False
+        self._decode_width1(live)
+        return True
+
+    def _decode_width1(self, live) -> None:
+        """One [slots, 1] masked decode call for ``live`` slots (page
+        capacity already ensured by the caller). Shared by the normal
+        path and the spec path's tail fallback."""
         t0 = time.perf_counter()
         toks = self._inactive_tok.copy()
         positions = np.zeros((self.slots,), np.int32)
@@ -1464,7 +1810,170 @@ class Engine:
             if self.chaos is not None:
                 self.chaos.on_token()   # kill/stall@tokens (post-push)
             self._slot_maybe_finish(i, nxt)
+
+    # -- speculative decode path (docs/serving.md) ----------------------
+
+    def _spec_decode_iteration(self) -> bool:
+        """One draft+verify cycle across the pool: burst-eligible
+        slots draft K tokens and verify them in one wide call (1..K+1
+        verified tokens each); tail slots — too close to max_seq_len
+        for a full burst — fall back to the existing width-1 program
+        in the same iteration. A slot nearing its TOKEN budget still
+        bursts: the emit loop breaks exactly at max_new_tokens (the
+        overshot verify positions are wasted compute, and the slot
+        releases its pages on finish), which keeps every live slot on
+        the wide program instead of serializing request tails into
+        width-1 iterations. POOL PRESSURE can also force a width-1
+        cycle; such a slot may re-enter the burst later with a
+        drafter-pool gap at the width-1-advanced positions. The gap
+        costs acceptance (garbage drafter K/V -> bad drafts), never
+        correctness: every emitted token comes from the verify (or
+        width-1 decode) program, and rejection falls back to one
+        verified token per cycle."""
+        live = [(i, s) for i, s in enumerate(self._active)
+                if s is not None]
+        if not live:
+            return False
+        k = self.spec_k
+        burst, seq_ready, blocked = [], [], []
+        for i, slot in live:
+            eligible = slot.pos + k + 1 <= self.max_seq_len
+            # A burst writes pos..pos+K (both pools; shared table) —
+            # ensure coverage through pos+K, or fall back to width-1
+            # coverage before counting the slot as blocked.
+            if eligible and self._ensure_page_capacity(
+                    i, slot, through_pos=slot.pos + k):
+                burst.append((i, slot))
+            elif self._ensure_page_capacity(i, slot):
+                seq_ready.append((i, slot))
+            else:
+                blocked.append((i, slot))
+        if blocked and not burst and not seq_ready:
+            self._preempt_slot(self._choose_preempt_victim(blocked))
+            return True              # freed pages; retry next iteration
+        self._update_kv_gauges()
+        if not burst and not seq_ready:
+            return False
+        if burst:
+            self._spec_burst(burst)
+        if seq_ready:
+            # Tail and capacity-starved slots advance one verified
+            # token through the plain width-1 program. Their drafter
+            # pool now lags the main cursor — benign per the
+            # docstring's acceptance-vs-correctness argument.
+            self._decode_width1([(i, s) for i, s in seq_ready
+                                 if self._active[i] is s])
         return True
+
+    def _spec_burst(self, burst) -> None:
+        """Draft K+1, verify K+1, accept, rewind — the spec hot path.
+        Acceptance (tpunet/serve/spec.py ``accept_drafts``) keeps the
+        longest prefix where draft d_j matched verify choice c_{j-1};
+        the slot emits c_0..c_a (ALL from the verify program, which is
+        the bitwise spec-off-parity argument), advances its cursor by
+        a+1, and the rejected tail pages go back to the free list."""
+        k = self.spec_k
+        reg = self.registry
+        t0 = time.perf_counter()
+        first = np.zeros((self.slots,), np.int32)
+        positions = np.zeros((self.slots,), np.int32)
+        active = np.zeros((self.slots,), bool)
+        for i, slot in burst:
+            first[i] = slot.next_token
+            positions[i] = slot.pos
+            active[i] = True
+        # Attention window: the smallest page-slot bucket covering
+        # every burst slot's pos+K. Both programs see the SLICED
+        # table — they attend over (and gather) only the live key
+        # window instead of all max_seq_len rows, which is where the
+        # verify's per-position cost lives on short sequences.
+        win = self._spec_window(
+            max(int(s.pos) + k for _, s in burst)
+            // self.page_tokens + 1)
+        table = self._page_table[:, :win]
+        # temp/top_k/top_p/seeds/steps0 — steps0[i] = len(req.tokens)
+        # is the sequential sampler's next step counter, so draft and
+        # verify keys stay in lockstep with the spec-off stream.
+        samp = self._sampling_args(self._zero_idx)[1:]
+        with _ring_span("tpunet/serve_spec_draft"):
+            self._draft_cache, drafts = self._dispatch_spec(
+                "spec_draft_burst", f"k{k}w{win}",
+                self._draft_burst_fn,
+                (self._drafter_params, self._draft_cache, first,
+                 positions, active, table, *samp))
+            drafts = np.asarray(drafts)
+        verify_toks = np.zeros((self.slots, k + 1), np.int32)
+        verify_toks[:, 0] = first
+        verify_toks[:, 1:] = drafts
+        with _ring_span("tpunet/serve_spec_verify"):
+            self._cache, choices = self._dispatch_spec(
+                "spec_verify", f"k{k}w{win}", self._verify_fn,
+                (self.variables["params"], self._cache, verify_toks,
+                 positions, active, table, *samp))
+            choices = np.asarray(choices)
+        lap = time.perf_counter() - t0
+        reg.counter("serve_decode_steps_total").inc()
+        reg.histogram("serve_decode_iter_s").observe(lap)
+        reg.histogram("serve_token_s").observe(lap)
+        from tpunet.serve import spec as serve_spec
+        rows = np.asarray([i for i, _ in burst])
+        accepted = serve_spec.accept_drafts(drafts[rows],
+                                            choices[rows])
+        for (i, slot), a in zip(burst, accepted):
+            a = int(a)
+            reg.counter("serve_spec_draft_tokens_total").inc(k)
+            reg.counter("serve_spec_accepted_tokens_total").inc(a)
+            reg.counter("serve_spec_rejected_tokens_total").inc(k - a)
+            reg.counter("serve_spec_verify_steps_total").inc()
+            finished = False
+            for j in range(a + 1):
+                tok = int(choices[i, j])
+                slot.pos += 1
+                slot.generated += 1
+                slot.next_token = tok
+                slot.req.push_token(tok)
+                reg.counter("serve_tokens_total").inc()
+                if self.chaos is not None:
+                    self.chaos.on_token()   # post-push: the token
+                    #                         reached the stream —
+                    #                         only VERIFIED tokens are
+                    #                         ever journaled upstream
+                if self._slot_maybe_finish(i, tok):
+                    finished = True
+                    break
+            if not finished:
+                self._rewind_slot_pages(i, slot)
+        drafted = reg.counter("serve_spec_draft_tokens_total").value
+        acc = reg.counter("serve_spec_accepted_tokens_total").value
+        reg.gauge("serve_spec_acceptance_rate").set(
+            round(acc / drafted, 4) if drafted else 0.0)
+        self._update_kv_gauges()
+
+    def _rewind_slot_pages(self, slot_i: int, slot: _Slot) -> None:
+        """Cursor rewind after a (partial) rejection: free the private
+        tail pages beyond the last verified position. The rows holding
+        rejected K/V are simply recycled — the masked write-then-read
+        invariant makes stale rows invisible, so the rewind is pure
+        host bookkeeping (no device work). Structurally clamped at
+        pinned prefix pages: a burst writes only positions >= the
+        prefill suffix start, which live on PRIVATE pages, and only
+        ``slot.pages`` (the private list) is ever freed — a shared
+        prefix page can never be rewound or mutated (pinned either at
+        admission COW time or never written at all; pinned by test in
+        tests/test_serve_paged.py)."""
+        keep_hi = (slot.pos - 1) // self.page_tokens
+        keep_private = max(0, keep_hi + 1 - len(slot.pinned))
+        tail = slot.pages[keep_private:]
+        if not tail:
+            return
+        del slot.pages[keep_private:]
+        base = len(slot.pinned) + keep_private
+        for j in range(base, base + len(tail)):
+            self._page_table[slot_i, j] = 0
+        # reversed(): the page covering the NEXT write position goes
+        # back on top of the LIFO free list, so the very next
+        # allocate-on-advance hands the same page straight back.
+        self._free_pages.extend(reversed(tail))
 
     # -- obs -------------------------------------------------------------
 

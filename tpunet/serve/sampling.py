@@ -91,3 +91,24 @@ def batched_sample(logits, temperature, top_k, top_p, seeds, steps):
     # least one resident row actually samples.
     return jax.lax.cond(jnp.any(temperature > 0), stochastic,
                         lambda: greedy)
+
+
+def batched_sample_positions(logits, temperature, top_k, top_p, seeds,
+                             steps0):
+    """Per-position sampling for the speculative verify step: one
+    token per (row, position) from ``logits`` [B, T, V] float32.
+
+    Position ``j`` of row ``b`` draws with step ``steps0[b] + j`` —
+    exactly the key the sequential decode loop would have used when
+    it reached that position, which is what makes spec-on sampled
+    output bitwise-identical to spec-off per (seed, step) and keeps
+    failover resume deterministic. ``T`` is static (K+1), so the
+    per-position loop unrolls at trace time into T reuses of the
+    [B]-wide ``batched_sample``. Returns int32 [B, T].
+    """
+    import jax.numpy as jnp
+
+    t = logits.shape[1]
+    cols = [batched_sample(logits[:, j], temperature, top_k, top_p,
+                           seeds, steps0 + j) for j in range(t)]
+    return jnp.stack(cols, axis=1)

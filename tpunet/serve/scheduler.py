@@ -152,6 +152,13 @@ class GenerateRequest:
         return self._cancelled.is_set()
 
     def push_token(self, token: int) -> None:
+        # The ONLY producer of ('token', t) events — everything
+        # downstream (streaming frontend, router relay, failover
+        # journal) sees exactly this sequence. Speculative decoding
+        # preserves that contract structurally: the engine pushes only
+        # VERIFIED tokens (draft proposals never reach a request), so
+        # a journal replayed after a mid-verify replica death resumes
+        # from a prefix of the canonical stream, never from drafts.
         now = time.perf_counter()
         if self.first_token_t is None:
             self.first_token_t = now

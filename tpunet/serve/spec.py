@@ -1,0 +1,235 @@
+"""Speculative decoding support: drafter construction + acceptance.
+
+Draft-then-verify decoding (docs/serving.md "Speculative decoding"):
+a narrow drafter proposes ``K`` tokens per active slot against its own
+paged KV pool, the serving model scores all ``K+1`` positions in ONE
+batched forward over the main pool, and the engine keeps the longest
+verified prefix. This module owns everything that is NOT engine
+plumbing:
+
+- ``drafter_model_config``: the width_mult lever applied to the
+  serving ``ModelConfig`` (vit_hidden scaled, kept divisible by
+  vit_heads so head_dim stays integral).
+- ``accept_drafts``: the pure acceptance rule. Verify consumes
+  ``[next_token, d_1..d_K]`` and produces choices ``c_0..c_K`` where
+  ``c_j`` is the model's (sampled or greedy) token AFTER position
+  ``pos+j``. The accepted count ``a`` is the longest prefix with
+  ``d_j == c_{j-1}``; the engine emits ``c_0..c_a`` — every emitted
+  token comes from the VERIFY distribution, so the output stream is
+  bitwise-identical to non-speculative decoding at ANY acceptance
+  rate (greedy and per-(seed, step) sampled alike).
+- ``save_drafter_params`` / ``load_drafter_params``: flat-npz
+  round-trip for drafter checkpoints (``--spec-draft-checkpoint``).
+- ``fit_drafter``: deterministic distillation of a drafter onto the
+  serving model's own greedy trajectories (hard-target cross-entropy,
+  hand-rolled Adam — no optimizer deps on the serve path). This is
+  the production fitting flow in miniature: you fit the drafter to
+  the traffic you serve; ``bench_serve.py --spec`` fits against the
+  bench workload's prompts the same way an operator distills against
+  logged traffic.
+
+Everything here is deterministic by construction — same inputs, same
+drafter, same acceptance — because failover resume and bitwise replay
+(tests/test_failover.py) depend on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpunet.config import ModelConfig
+
+__all__ = [
+    "drafter_model_config",
+    "accept_drafts",
+    "save_drafter_params",
+    "load_drafter_params",
+    "fit_drafter",
+]
+
+
+def drafter_model_config(cfg: ModelConfig,
+                         width_mult: float) -> ModelConfig:
+    """The drafter's ModelConfig: ``vit_hidden`` scaled by
+    ``width_mult`` and rounded DOWN to the nearest multiple of
+    ``vit_heads`` (floor one full head) so attention head_dim stays
+    integral. Depth, vocab, and max_seq_len are preserved — the
+    drafter must cover the same positions the serving model does."""
+    if width_mult <= 0:
+        raise ValueError(f"spec_draft_width_mult must be > 0, "
+                         f"got {width_mult}")
+    heads = cfg.vit_heads
+    hidden = int(cfg.vit_hidden * width_mult) // heads * heads
+    hidden = max(heads, hidden)
+    return dataclasses.replace(cfg, vit_hidden=hidden)
+
+
+def accept_drafts(drafts: np.ndarray, choices: np.ndarray) -> np.ndarray:
+    """Accepted-token counts per row.
+
+    ``drafts``: ``[B, K]`` drafter proposals ``d_1..d_K``.
+    ``choices``: ``[B, K+1]`` verify outputs ``c_0..c_K`` (the model's
+    token after each of positions ``pos..pos+K``).
+
+    Returns ``a`` ``[B]`` with ``0 <= a[i] <= K``: the longest prefix
+    where ``d_j == c_{j-1}``. The engine then emits ``c_0..c_a`` —
+    ``a+1`` tokens, all from the verify pass. ``c_a`` doubles as the
+    next cycle's input token (the "bonus" token on full acceptance).
+    """
+    drafts = np.asarray(drafts)
+    choices = np.asarray(choices)
+    if drafts.ndim != 2 or choices.ndim != 2 \
+            or choices.shape != (drafts.shape[0], drafts.shape[1] + 1):
+        raise ValueError(
+            f"shape mismatch: drafts {drafts.shape} vs choices "
+            f"{choices.shape} (want [B, K] and [B, K+1])")
+    match = drafts == choices[:, :-1]
+    # First mismatch position == accepted count; all-match rows accept
+    # the full K (argmin on an all-True row returns 0, so patch them).
+    a = np.argmin(match, axis=1)
+    a[match.all(axis=1)] = drafts.shape[1]
+    return a.astype(np.int64)
+
+
+def _flatten(params, prefix=""):
+    out = {}
+    for key in sorted(params):
+        val = params[key]
+        path = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(val, dict):
+            out.update(_flatten(val, path))
+        else:
+            out[path] = np.asarray(val)
+    return out
+
+
+def save_drafter_params(path: str, params) -> None:
+    """Write a drafter param tree as a flat ``.npz`` (keys are
+    ``/``-joined tree paths). Torn-write-safe via tmp + rename like
+    every other artifact writer in the repo."""
+    import os
+    import tempfile
+
+    flat = _flatten(params)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_drafter_params(path: str, like):
+    """Load a ``save_drafter_params`` npz into the structure of
+    ``like`` (a template param tree from the drafter model's init).
+    Every leaf must be present with the template's exact shape — a
+    drafter checkpoint from a different width/depth is a config error,
+    not something to silently pad."""
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    template = _flatten(like)
+    missing = sorted(set(template) - set(flat))
+    extra = sorted(set(flat) - set(template))
+    if missing or extra:
+        raise ValueError(
+            f"drafter checkpoint {path!r} does not match the drafter "
+            f"architecture: missing={missing[:4]} extra={extra[:4]}")
+    for k, tmpl in template.items():
+        if flat[k].shape != tmpl.shape:
+            raise ValueError(
+                f"drafter checkpoint {path!r} leaf {k!r} has shape "
+                f"{flat[k].shape}, drafter wants {tmpl.shape}")
+
+    def rebuild(node, prefix=""):
+        if isinstance(node, dict):
+            return {k: rebuild(v, f"{prefix}/{k}" if prefix else str(k))
+                    for k, v in node.items()}
+        return jnp.asarray(flat[prefix], dtype=np.asarray(node).dtype)
+
+    return rebuild(like)
+
+
+def fit_drafter(model, params, drafter_model, drafter_params, prompts,
+                *, gen_tokens: int = 64, steps: int = 300,
+                lr: float = 3e-3, log=None):
+    """Distill ``drafter_model`` onto ``model``'s greedy trajectories.
+
+    ``prompts`` is ``[N, P]`` int32 — the traffic to fit against. The
+    teacher generates ``gen_tokens`` greedy continuations (dense
+    full-prefix forwards; O(L^2) but the fitting set is small), then
+    the drafter minimizes hard-target cross-entropy on the generated
+    region with a hand-rolled Adam. Fully deterministic: same teacher,
+    prompts, and init produce bitwise-identical drafter params, which
+    keeps spec-on serving replayable.
+
+    Returns the fitted drafter param tree.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    prompts = np.asarray(prompts, np.int32)
+    n, plen = prompts.shape
+    total = plen + gen_tokens
+    max_len = getattr(drafter_model, "max_len", None)
+    if max_len is not None and total > max_len:
+        raise ValueError(
+            f"fit window {total} exceeds drafter max_len {max_len}")
+
+    @jax.jit
+    def teacher_step(p, toks):
+        lg = model.apply({"params": p}, toks, train=False)
+        return jnp.argmax(lg[:, -1].astype(jnp.float32), -1).astype(
+            jnp.int32)
+
+    seqs = np.zeros((n, total), np.int32)
+    seqs[:, :plen] = prompts
+    cur = jnp.asarray(seqs)
+    for i in range(plen, total):
+        nxt = teacher_step(params, cur[:, :i])
+        cur = cur.at[:, i].set(nxt)
+    toks = cur
+
+    def loss_fn(dp):
+        lg = drafter_model.apply({"params": dp}, toks[:, :-1],
+                                 train=False)
+        tgt = toks[:, 1:]
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+        mask = (jnp.arange(total - 1)[None, :] >= plen - 1).astype(
+            jnp.float32)
+        return (nll * mask).sum() / mask.sum() / n
+
+    @jax.jit
+    def adam_step(dp, m, v, t):
+        g = jax.grad(loss_fn)(dp)
+        m = jax.tree_util.tree_map(
+            lambda a, b: 0.9 * a + 0.1 * b, m, g)
+        v = jax.tree_util.tree_map(
+            lambda a, b: 0.999 * a + 0.001 * b * b, v, g)
+        mh = jax.tree_util.tree_map(lambda a: a / (1 - 0.9 ** t), m)
+        vh = jax.tree_util.tree_map(lambda a: a / (1 - 0.999 ** t), v)
+        dp = jax.tree_util.tree_map(
+            lambda p, a, b: p - lr * a / (jnp.sqrt(b) + 1e-8),
+            dp, mh, vh)
+        return dp, m, v
+
+    mom = jax.tree_util.tree_map(jnp.zeros_like, drafter_params)
+    vel = jax.tree_util.tree_map(jnp.zeros_like, drafter_params)
+    dp = drafter_params
+    for t in range(1, steps + 1):
+        dp, mom, vel = adam_step(dp, mom, vel, jnp.float32(t))
+        if log is not None and t % 100 == 0:
+            log(f"fit_drafter step {t}/{steps}: "
+                f"loss {float(loss_fn(dp)):.4f}")
+    return dp
